@@ -1,0 +1,297 @@
+"""Data layer tests: parsers, RowBlock, MinibatchIter, CRB, match_file,
+config. The unit layer the reference lacks (SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.config import load_config, parse_conf_text
+from wormhole_tpu.data import crb
+from wormhole_tpu.data.match_file import match_file
+from wormhole_tpu.data.minibatch import MinibatchIter, _take_rows
+from wormhole_tpu.data.parsers import (
+    iter_file_chunks,
+    parse_adfea,
+    parse_criteo,
+    parse_libsvm,
+)
+from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
+from wormhole_tpu.ops.hashing import cityhash64, pack_field_key, reverse_bytes_u64
+
+
+# ---------------------------------------------------------------- hashing
+def test_cityhash64_stable():
+    # regression pins for our implementation
+    assert cityhash64("") == 0x9AE16A3B2F90404F
+    vecs = {len(s): cityhash64(s) for s in ["a", "abcd", "12345678",
+                                           "x" * 20, "y" * 40, "z" * 70]}
+    assert len(set(vecs.values())) == len(vecs)  # all distinct
+
+
+def test_cityhash64_avalanche():
+    a, b = cityhash64("feature_1"), cityhash64("feature_2")
+    assert bin(a ^ b).count("1") > 16
+
+
+def test_pack_field_key():
+    k = pack_field_key("deadbeef", 5)
+    assert k >> 54 == 5
+    assert pack_field_key("deadbeef", 1023) >> 54 == 1023
+
+
+def test_reverse_bytes():
+    x = np.array([0x0102030405060708], dtype=np.uint64)
+    assert reverse_bytes_u64(x)[0] == 0x0807060504030201
+    seq = np.arange(1000, dtype=np.uint64)
+    rev = reverse_bytes_u64(seq)
+    assert len(np.unique(rev)) == 1000  # bijective
+    np.testing.assert_array_equal(reverse_bytes_u64(rev), seq)
+
+
+# ---------------------------------------------------------------- parsers
+def test_parse_libsvm():
+    blk = parse_libsvm("1 3:1 10:2.5\n0 1:1\n# comment\n-1 5:1\n")
+    assert blk.size == 3
+    assert blk.nnz == 4
+    np.testing.assert_array_equal(blk.label, [1, 0, -1])
+    np.testing.assert_array_equal(blk.index, [3, 10, 1, 5])
+    np.testing.assert_array_equal(blk.value, [1, 2.5, 1, 1])
+
+
+def test_parse_libsvm_binary_compaction():
+    blk = parse_libsvm("1 3:1 10:1\n0 1:1\n")
+    assert blk.value is None  # all-ones value array dropped
+
+
+def test_parse_criteo():
+    line = "1\t5\t\t12\t" + "\t".join(["a93bc2f1"] * 26) + "\n"
+    blk = parse_criteo(line)
+    assert blk.size == 1
+    assert blk.label[0] == 1
+    # 2 present ints (one field empty) + 26 cats
+    assert blk.nnz == 28
+    fields = (blk.index >> np.uint64(54)).astype(int)
+    assert fields[0] == 0 and fields[1] == 2  # field ids packed in top bits
+    # identical categorical tokens in different fields get different keys
+    assert len(np.unique(blk.index[2:])) == 26
+
+
+def test_parse_criteo_test_mode():
+    line = "5\t\t12\t" + "\t".join(["a93bc2f1"] * 26) + "\n"
+    blk = parse_criteo(line, has_label=False)
+    assert blk.size == 1 and blk.label[0] == 0 and blk.nnz == 28
+
+
+def test_parse_adfea():
+    blk = parse_adfea("100 3 1 12345:3 678:3 999:7\n101 1 0 12345:3\n")
+    assert blk.size == 2
+    np.testing.assert_array_equal(blk.label, [1, 0])
+    assert (blk.index[0] >> np.uint64(54)) == 3
+    assert blk.index[0] == blk.index[3]  # same fid:gid -> same key
+
+
+# ---------------------------------------------------------------- rowblock
+def test_rowblock_slice_concat():
+    blk = parse_libsvm("1 1:2\n0 2:3 3:4\n1 4:5\n0 5:6 6:7 7:8\n")
+    a, b = blk.slice(0, 2), blk.slice(2, 4)
+    back = RowBlock.concat([a, b])
+    np.testing.assert_array_equal(back.label, blk.label)
+    np.testing.assert_array_equal(back.offset, blk.offset)
+    np.testing.assert_array_equal(back.index, blk.index)
+    np.testing.assert_array_equal(back.value, blk.value)
+
+
+def test_take_rows_permutation():
+    blk = parse_libsvm("1 1:2\n0 2:3 3:4\n1 4:5\n")
+    perm = _take_rows(blk, np.array([2, 0, 1]))
+    np.testing.assert_array_equal(perm.label, [1, 1, 0])
+    np.testing.assert_array_equal(perm.index, [4, 1, 2, 3])
+    np.testing.assert_array_equal(perm.value, [5, 2, 3, 4])
+
+
+def test_device_batch_padding():
+    blk = parse_libsvm("1 3:1 10:2.5\n0 1:1\n")
+    db = to_device_batch(blk, num_rows=4, capacity=8, num_buckets=16)
+    assert db.val[3:].sum() == 0  # padding contributes nothing
+    np.testing.assert_array_equal(db.row_mask, [1, 1, 0, 0])
+    np.testing.assert_array_equal(db.idx[:3], [3, 10, 1])
+
+
+def test_device_batch_truncation():
+    blk = parse_libsvm("1 1:1 2:1 3:1\n0 4:1\n")
+    db = to_device_batch(blk, num_rows=1, capacity=2, num_buckets=16)
+    assert db.num_rows == 1 and db.capacity == 2
+
+
+# ---------------------------------------------------------------- splits
+def test_input_split_disjoint_cover(tmp_path):
+    p = tmp_path / "d.txt"
+    lines = [f"{i} {i}:1" for i in range(997)]
+    p.write_text("\n".join(lines) + "\n")
+    got = []
+    for part in range(4):
+        for chunk in iter_file_chunks(str(p), part, 4):
+            got += chunk.splitlines()
+    assert got == lines  # disjoint and complete, in order
+
+
+def test_minibatch_iter_sizes(synth_libsvm_file):
+    mbs = list(MinibatchIter(synth_libsvm_file, 0, 1, "libsvm",
+                             minibatch_size=100))
+    assert [m.size for m in mbs] == [100, 100, 100, 100, 100, 12]
+
+
+def test_minibatch_iter_parts_cover(synth_libsvm_file):
+    total = sum(
+        m.size
+        for part in range(3)
+        for m in MinibatchIter(synth_libsvm_file, part, 3, "libsvm",
+                               minibatch_size=64)
+    )
+    assert total == 512
+
+
+def test_minibatch_shuffle_preserves_rows(synth_libsvm_file):
+    plain = list(MinibatchIter(synth_libsvm_file, 0, 1, "libsvm",
+                               minibatch_size=64))
+    shuf = list(MinibatchIter(synth_libsvm_file, 0, 1, "libsvm",
+                              minibatch_size=64, shuf_buf=200, seed=7))
+    tot = RowBlock.concat(plain)
+    tot_s = RowBlock.concat(shuf)
+    assert tot_s.size == tot.size and tot_s.nnz == tot.nnz
+    assert not np.array_equal(tot_s.label, tot.label)  # actually shuffled
+    assert sorted(tot_s.index.tolist()) == sorted(tot.index.tolist())
+
+
+def test_neg_sampling(synth_libsvm_file):
+    full = RowBlock.concat(list(MinibatchIter(synth_libsvm_file,
+                                              minibatch_size=64)))
+    samp = RowBlock.concat(
+        list(MinibatchIter(synth_libsvm_file, minibatch_size=64,
+                           neg_sampling=0.2, seed=3))
+    )
+    n_pos_full = int((full.label > 0).sum())
+    n_pos_samp = int((samp.label > 0).sum())
+    assert n_pos_samp == n_pos_full  # positives always kept
+    assert (samp.size - n_pos_samp) < (full.size - n_pos_full) * 0.5
+
+
+# ---------------------------------------------------------------- crb
+def test_crb_roundtrip(tmp_path, synth_libsvm_file):
+    mbs = list(MinibatchIter(synth_libsvm_file, minibatch_size=100))
+    path = str(tmp_path / "d.crb")
+    assert crb.write_crb(path, mbs) == len(mbs)
+    back = list(crb.read_crb(path))
+    assert len(back) == len(mbs)
+    for a, b in zip(back, mbs):
+        np.testing.assert_array_equal(a.label, b.label)
+        np.testing.assert_array_equal(a.index, b.index)
+        np.testing.assert_array_equal(
+            a.value if a.value is not None else [],
+            b.value if b.value is not None else [])
+
+
+def test_crb_parts(tmp_path, synth_libsvm_file):
+    mbs = list(MinibatchIter(synth_libsvm_file, minibatch_size=50))
+    path = str(tmp_path / "d.crb")
+    crb.write_crb(path, mbs)
+    n = sum(b.size for part in range(3) for b in crb.read_crb(path, part, 3))
+    assert n == 512
+
+
+def test_crb_via_minibatch_iter(tmp_path, synth_libsvm_file):
+    mbs = list(MinibatchIter(synth_libsvm_file, minibatch_size=50))
+    path = str(tmp_path / "d.crb")
+    crb.write_crb(path, mbs)
+    out = list(MinibatchIter(path, fmt="crb", minibatch_size=128))
+    assert sum(m.size for m in out) == 512
+    assert [m.size for m in out[:-1]] == [128] * (len(out) - 1)
+
+
+# ---------------------------------------------------------------- files
+def test_match_file(tmp_path):
+    for i in range(5):
+        (tmp_path / f"part-{i}.txt").write_text("x")
+    (tmp_path / "other.dat").write_text("x")
+    got = match_file(str(tmp_path / r"part-\d+\.txt"))
+    assert len(got) == 5
+    exact = match_file(str(tmp_path / "other.dat"))
+    assert exact == [str(tmp_path / "other.dat")]
+
+
+# ---------------------------------------------------------------- config
+def test_config_merge(tmp_path):
+    import dataclasses
+    from typing import Optional
+
+    @dataclasses.dataclass
+    class Conf:
+        train_data: str = ""
+        val_data: Optional[str] = None
+        minibatch: int = 1000
+        lr_eta: float = 0.1
+        lambda_l1: float = 0.0
+        algo: str = "ftrl"
+        shuffle: bool = False
+
+    p = tmp_path / "demo.conf"
+    p.write_text(
+        "train_data = data/train\n"
+        "minibatch = 500  # comment\n"
+        'algo = "sgd"\n'
+        "lambda_l1 = 4\n"
+    )
+    cfg = load_config(Conf, str(p), ["minibatch=250", "shuffle=true"])
+    assert cfg.train_data == "data/train"
+    assert cfg.minibatch == 250  # CLI wins
+    assert cfg.algo == "sgd"
+    assert cfg.lambda_l1 == 4.0
+    assert cfg.shuffle is True
+    with pytest.raises(ValueError):
+        load_config(Conf, None, ["nonexistent_key=1"])
+
+
+def test_parse_conf_repeated():
+    kv = parse_conf_text("a = 1\na = 2\nb = x\n")
+    assert kv["a"] == ["1", "2"]
+
+
+def test_config_repeated_field_accumulates(tmp_path):
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Conf:
+        val_data: list = dataclasses.field(default_factory=list)
+
+    Conf.__dataclass_fields__["val_data"].type = "list[str]"
+    p = tmp_path / "c.conf"
+    p.write_text("val_data = a\nval_data = b\n")
+    cfg = load_config(Conf, str(p), ["val_data=c"])
+    assert cfg.val_data == ["a", "b", "c"]  # CLI appends for repeated fields
+
+
+def test_minibatch_early_abandon_no_thread_leak(synth_libsvm_file):
+    import threading
+    import gc
+
+    before = threading.active_count()
+    for _ in range(20):
+        it = iter(MinibatchIter(synth_libsvm_file, minibatch_size=16))
+        next(it)  # peek one batch, abandon
+        del it
+    gc.collect()
+    deadline = 50  # producer poll interval is 0.2s
+    import time
+    while threading.active_count() > before and deadline:
+        time.sleep(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before + 1
+
+
+def test_agaricus_parses(agaricus):
+    train, test = agaricus
+    blk = RowBlock.concat(list(MinibatchIter(train, minibatch_size=1000)))
+    assert blk.size > 1500
+    assert set(np.unique(blk.label)) <= {0.0, 1.0}
+    assert blk.value is None  # agaricus is binary -> compacted

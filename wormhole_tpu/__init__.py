@@ -1,0 +1,23 @@
+"""wormhole-tpu: a TPU-native distributed machine-learning framework.
+
+Capabilities mirror DMLC Wormhole (reference: mstebelev/wormhole): sparse
+linear models (SGD/AdaGrad/FTRL), the DiFacto factorization machine, k-means,
+distributed L-BFGS/OWL-QN, and histogram GBDT — redesigned for TPU:
+
+- model/optimizer state lives as named-sharded jax Arrays in HBM (the
+  "parameter server" of ps-lite becomes a hashed, mesh-sharded table);
+- gradient aggregation and parameter exchange are XLA collectives (psum /
+  all-gather / reduce-scatter) over ICI/DCN under jit/shard_map, replacing
+  rabit allreduce and zmq push/pull;
+- sparse feature-matrix x weight products compile to XLA segment ops and
+  Pallas kernels;
+- the host side (data parsing, workload scheduling, minibatch streaming)
+  keeps Wormhole's architecture: parsers, MinibatchIter, WorkloadPool,
+  scheduler/worker harness — with the hot parsing path in native C++.
+
+See SURVEY.md for the reference structural analysis this build follows.
+"""
+
+__version__ = "0.1.0"
+
+from wormhole_tpu.data.rowblock import RowBlock, DeviceBatch  # noqa: F401

@@ -1,0 +1,3 @@
+from wormhole_tpu.data.rowblock import RowBlock, DeviceBatch  # noqa: F401
+from wormhole_tpu.data.minibatch import MinibatchIter  # noqa: F401
+from wormhole_tpu.data.match_file import match_file  # noqa: F401

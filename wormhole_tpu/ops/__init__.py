@@ -1,0 +1,1 @@
+from wormhole_tpu.ops.hashing import cityhash64, reverse_bytes_u64  # noqa: F401
