@@ -1,0 +1,166 @@
+"""Unit tests for device ops: spmv/spmm, metrics, penalty, localizer."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from wormhole_tpu.data.parsers import parse_libsvm
+from wormhole_tpu.data.rowblock import to_device_batch
+from wormhole_tpu.ops import metrics as M
+from wormhole_tpu.ops.localizer import localize, localize_block
+from wormhole_tpu.ops.penalty import l1l2_solve
+from wormhole_tpu.ops.spmv import row_squares, spmm, spmm_t, spmv, spmv_t
+
+
+def _dense_from_batch(db, num_buckets):
+    """Padding-aware dense matrix for cross-checking segment kernels."""
+    D = np.zeros((db.num_rows, num_buckets), dtype=np.float64)
+    for s, i, v in zip(db.seg, db.idx, db.val):
+        D[s, i] += v
+    return D
+
+
+@pytest.fixture
+def batch():
+    blk = parse_libsvm(
+        "1 0:1.5 3:2 7:0.5\n0 1:1 3:1\n1 7:4\n0 0:1 1:1 2:1 3:1\n"
+    )
+    return to_device_batch(blk, num_rows=4, capacity=16, num_buckets=8)
+
+
+def test_spmv_matches_dense(batch):
+    w = np.arange(8, dtype=np.float32) * 0.3 - 1
+    D = _dense_from_batch(batch, 8)
+    got = spmv(batch.seg, batch.idx, batch.val, jnp.asarray(w), 4)
+    np.testing.assert_allclose(got, D @ w, rtol=1e-5)
+
+
+def test_spmv_t_matches_dense(batch):
+    d = np.array([1.0, -2.0, 0.5, 3.0], dtype=np.float32)
+    D = _dense_from_batch(batch, 8)
+    got = spmv_t(batch.seg, batch.idx, batch.val, jnp.asarray(d), 8)
+    np.testing.assert_allclose(got, D.T @ d, rtol=1e-5)
+
+
+def test_spmm_matches_dense(batch):
+    k = 3
+    V = np.random.default_rng(0).normal(size=(8, k)).astype(np.float32)
+    D = _dense_from_batch(batch, 8)
+    got = spmm(batch.seg, batch.idx, batch.val, jnp.asarray(V), 4)
+    np.testing.assert_allclose(got, D @ V, rtol=1e-4, atol=1e-5)
+
+
+def test_spmm_t_matches_dense(batch):
+    k = 3
+    Dm = np.random.default_rng(1).normal(size=(4, k)).astype(np.float32)
+    D = _dense_from_batch(batch, 8)
+    got = spmm_t(batch.seg, batch.idx, batch.val, jnp.asarray(Dm), 8)
+    np.testing.assert_allclose(got, D.T @ Dm, rtol=1e-4, atol=1e-5)
+
+
+def test_row_squares(batch):
+    V = np.random.default_rng(2).normal(size=(8, 2)).astype(np.float32)
+    D = _dense_from_batch(batch, 8)
+    got = row_squares(batch.seg, batch.idx, batch.val, jnp.asarray(V), 4)
+    np.testing.assert_allclose(got, (D ** 2) @ (V ** 2), rtol=1e-4, atol=1e-5)
+
+
+# -------------------------------------------------------------- metrics
+def _auc_brute(y, s):
+    pos = s[y > 0.5]
+    neg = s[y <= 0.5]
+    tot = 0.0
+    for p in pos:
+        for q in neg:
+            tot += 1.0 if p > q else (0.5 if p == q else 0.0)
+    return tot / (len(pos) * len(neg))
+
+
+def test_auc_against_bruteforce():
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        y = (rng.random(40) > 0.4).astype(np.float32)
+        s = rng.normal(size=40).astype(np.float32)
+        if trial == 0:
+            s = np.round(s)  # force ties
+        mask = np.ones(40, np.float32)
+        got = float(M.auc(jnp.asarray(y), jnp.asarray(s), jnp.asarray(mask)))
+        np.testing.assert_allclose(got, _auc_brute(y, s), rtol=1e-5)
+
+
+def test_auc_respects_mask():
+    y = np.array([1, 0, 1, 0, 1], np.float32)
+    s = np.array([2.0, 1.0, 3.0, -1.0, -99.0], np.float32)
+    mask = np.array([1, 1, 1, 1, 0], np.float32)
+    got = float(M.auc(jnp.asarray(y), jnp.asarray(s), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, _auc_brute(y[:4], s[:4]), rtol=1e-6)
+
+
+def test_logloss_accuracy_copc():
+    y = np.array([1, 0, 1, 0], np.float32)
+    s = np.array([10.0, -10.0, 10.0, -10.0], np.float32)
+    mask = np.ones(4, np.float32)
+    assert float(M.accuracy(y, s, mask)) == 1.0
+    assert float(M.logloss(y, s, mask)) < 1e-3
+    np.testing.assert_allclose(float(M.copc(y, s, mask)), 1.0, rtol=1e-3)
+    # masked rows excluded
+    mask2 = np.array([1, 1, 0, 0], np.float32)
+    assert float(M.accuracy(y, -s, mask2)) == 0.0
+
+
+# -------------------------------------------------------------- penalty
+def test_l1l2_solve():
+    # no regularization: plain division
+    np.testing.assert_allclose(
+        np.asarray(l1l2_solve(jnp.asarray([2.0, -4.0]), 2.0, 0.0, 0.0)),
+        [1.0, -2.0])
+    # l1 soft-thresholds to zero
+    got = np.asarray(l1l2_solve(jnp.asarray([0.5, -0.5, 3.0]), 1.0, 1.0, 0.0))
+    np.testing.assert_allclose(got, [0.0, 0.0, 2.0])
+    # l2 shrinks denominator
+    np.testing.assert_allclose(
+        np.asarray(l1l2_solve(jnp.asarray([4.0]), 1.0, 0.0, 3.0)), [1.0])
+
+
+# -------------------------------------------------------------- localizer
+def test_localize():
+    keys = np.array([9, 2, 9, 7, 2, 2], dtype=np.uint64)
+    loc = localize(keys)
+    np.testing.assert_array_equal(loc.uniq_keys, [2, 7, 9])
+    np.testing.assert_array_equal(loc.counts, [3, 1, 2])
+    np.testing.assert_array_equal(loc.local_index, [2, 0, 2, 1, 0, 0])
+
+
+def test_communicator_allreduce_shards():
+    from wormhole_tpu.parallel.collectives import Communicator
+    from wormhole_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, 1)
+    comm = Communicator(mesh)
+    x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    got = np.asarray(comm.allreduce_shards(x))
+    assert got.shape == (3,)  # reduced, not (1, 3)
+    np.testing.assert_allclose(got, x.sum(axis=0))
+    v = np.asarray(comm.allreduce_shards(np.ones(8, np.float32)))
+    assert v.shape == () and v == 8
+
+
+def test_device_batch_overflow_drops_whole_rows():
+    from wormhole_tpu.data.rowblock import to_device_batch
+
+    blk = parse_libsvm("1 1:1 2:1 3:1\n0 4:1 5:1\n1 6:1\n")
+    # capacity 4: row0 (3 nnz) fits, row1 (2 nnz) would straddle -> rows 1,2
+    # dropped whole rather than truncated
+    db = to_device_batch(blk, num_rows=3, capacity=4, num_buckets=16)
+    assert db.dropped_rows == 2
+    np.testing.assert_array_equal(db.row_mask, [1, 0, 0])
+    assert db.val[3:].sum() == 0
+
+
+def test_localize_block():
+    blk = parse_libsvm("1 1000000:1 5:2\n0 5:1\n")
+    loc, remapped = localize_block(blk)
+    np.testing.assert_array_equal(loc.uniq_keys, [5, 1000000])
+    np.testing.assert_array_equal(remapped.index, [1, 0, 0])
+    np.testing.assert_array_equal(remapped.value, blk.value)

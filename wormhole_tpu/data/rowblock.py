@@ -122,6 +122,7 @@ class DeviceBatch:
     val:      float32[capacity] feature value (padding -> 0)
     label:    float32[num_rows] (padding rows -> 0)
     row_mask: float32[num_rows] 1 for real rows, 0 for padding
+    dropped_rows: examples excluded because the batch overflowed capacity
     """
 
     seg: np.ndarray
@@ -129,6 +130,7 @@ class DeviceBatch:
     val: np.ndarray
     label: np.ndarray
     row_mask: np.ndarray
+    dropped_rows: int = 0
 
     @property
     def num_rows(self) -> int:
@@ -160,13 +162,23 @@ def to_device_batch(
 
     If ``index_map`` is given it is used as the per-nonzero bucket ids
     (already localized); otherwise raw ids are bucketized mod num_buckets.
-    Rows beyond ``num_rows`` and nonzeros beyond ``capacity`` are dropped
-    (callers size capacity so overflow is impossible or negligible).
+    Rows beyond ``num_rows`` are dropped. If the nonzeros overflow
+    ``capacity``, the partially-represented row and everything after it are
+    dropped whole (masked out) rather than trained on truncated features;
+    the count is reported in ``dropped_rows`` so callers can warn.
     """
+    dropped = max(blk.size - num_rows, 0)
     n = min(blk.size, num_rows)
     if blk.size > num_rows:
         blk = blk.slice(0, num_rows)
-    nnz = min(blk.nnz, capacity)
+    nnz = int(blk.nnz)
+    if nnz > capacity:
+        # keep only rows fully contained in the first `capacity` nonzeros
+        cut = int(np.searchsorted(blk.offset, capacity, side="right")) - 1
+        dropped += n - cut
+        n = cut
+        blk = blk.slice(0, cut)
+        nnz = int(blk.nnz)
 
     seg = np.full(capacity, max(num_rows - 1, 0), dtype=np.int32)
     idx = np.zeros(capacity, dtype=np.int32)
@@ -178,17 +190,16 @@ def to_device_batch(
     seg_src = np.repeat(
         np.arange(n, dtype=np.int32), np.diff(blk.offset[: n + 1]).astype(np.int64)
     )
-    seg[:nnz] = seg_src[:nnz]
+    seg[:nnz] = seg_src
     if index_map is not None:
         idx[:nnz] = index_map[:nnz]
     else:
-        idx[:nnz] = bucketize(blk.index[:nnz], num_buckets)
-    vals = blk.values_or_ones()
-    val[:nnz] = vals[:nnz]
+        idx[:nnz] = bucketize(blk.index, num_buckets)
+    val[:nnz] = blk.values_or_ones()
     if blk.weight is not None:
-        val_w = blk.weight[seg_src[:nnz]]
         # example weights fold into the values for linear models
-        val[:nnz] *= val_w
+        val[:nnz] *= blk.weight[seg_src]
     label[:n] = blk.label[:n]
     row_mask[:n] = 1.0
-    return DeviceBatch(seg=seg, idx=idx, val=val, label=label, row_mask=row_mask)
+    return DeviceBatch(seg=seg, idx=idx, val=val, label=label,
+                       row_mask=row_mask, dropped_rows=dropped)

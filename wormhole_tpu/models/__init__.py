@@ -1,0 +1,1 @@
+from wormhole_tpu.models.linear import LinearConfig, LinearLearner  # noqa: F401

@@ -1,0 +1,52 @@
+"""Localizer: compact a minibatch's arbitrary uint64 keys to dense ids.
+
+Parity with reference learn/base/localizer.h:42-221: given a RowBlock whose
+`index` holds raw 64-bit feature keys, produce (a) the sorted unique key
+list, (b) per-key occurrence counts (difacto's embedding-admission signal),
+and (c) the RowBlock remapped to positions into that unique list. The
+reference does a parallel sort + unique + remap on the CPU; numpy's sort
+machinery plays the same role here, feeding fixed-capacity device buffers.
+
+Key spreading (byte reversal / hash-kernel mod, localizer.h:16-26,107-115)
+lives in wormhole_tpu.ops.hashing and wormhole_tpu.data.rowblock.bucketize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from wormhole_tpu.data.rowblock import RowBlock
+
+
+@dataclasses.dataclass
+class Localized:
+    uniq_keys: np.ndarray   # uint64[n_uniq], sorted ascending
+    counts: np.ndarray      # int32[n_uniq] occurrences in the block
+    local_index: np.ndarray  # int32[nnz] positions into uniq_keys
+
+
+def localize(block_index: np.ndarray) -> Localized:
+    """Map raw keys to [0, n_uniq) (reference Localize, localizer.h:98-221)."""
+    keys = np.ascontiguousarray(block_index, dtype=np.uint64)
+    uniq, inv, counts = np.unique(keys, return_inverse=True, return_counts=True)
+    return Localized(
+        uniq_keys=uniq,
+        counts=counts.astype(np.int32),
+        local_index=inv.astype(np.int32),
+    )
+
+
+def localize_block(blk: RowBlock) -> tuple[Localized, RowBlock]:
+    """Localize a RowBlock: returns the mapping and the remapped block whose
+    index column holds local ids (fits int32, dense in [0, n_uniq))."""
+    loc = localize(blk.index)
+    remapped = RowBlock(
+        label=blk.label,
+        offset=blk.offset,
+        index=loc.local_index.astype(np.uint64),
+        value=blk.value,
+        weight=blk.weight,
+    )
+    return loc, remapped

@@ -1,0 +1,76 @@
+"""Binary-classification metrics on device (BinClassEval parity).
+
+Reference learn/base/binary_class_evaluation.h computes AUC (:17-38),
+accuracy (:40-51), logloss (:53-64), logit objective (:66-74) and COPC
+(:76-85) with OpenMP; here each is a jit-able jax reduction over masked
+fixed-shape batches. Labels are 0/1 (masked rows excluded via weight 0).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def auc(y, score, mask):
+    """Rank-based AUC: P(score_pos > score_neg). Ties get 0.5 credit via
+    average ranks. Masked rows are pushed to -inf and excluded from counts."""
+    neg_inf = jnp.asarray(-jnp.inf, score.dtype)
+    s = jnp.where(mask > 0, score, neg_inf)
+    order = jnp.argsort(s)
+    ranks = jnp.zeros_like(s).at[order].set(
+        jnp.arange(1, s.shape[0] + 1, dtype=score.dtype))
+    # average ranks over exact ties so permutation order doesn't matter
+    # (sort-based tie handling as in the reference's area accumulation)
+    sorted_s = s[order]
+    uniq_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_s[1:] != sorted_s[:-1]])
+    group_id = jnp.cumsum(uniq_start) - 1
+    group_id_per_elem = jnp.zeros_like(group_id).at[order].set(group_id)
+    num_groups = s.shape[0]
+    gsum = jax.ops.segment_sum(ranks, group_id_per_elem, num_segments=num_groups)
+    gcnt = jax.ops.segment_sum(jnp.ones_like(ranks), group_id_per_elem,
+                               num_segments=num_groups)
+    avg_rank = (gsum / jnp.maximum(gcnt, 1))[group_id_per_elem]
+    pos = (y > 0.5) & (mask > 0)
+    neg = (y <= 0.5) & (mask > 0)
+    n_pos = jnp.sum(pos)
+    n_neg = jnp.sum(neg)
+    # masked rows sort to the bottom and occupy ranks 1..n_masked; shifting
+    # real ranks down by n_masked makes them ranks among real rows only
+    n_masked = jnp.sum(mask <= 0)
+    rank_sum_pos = jnp.sum(jnp.where(pos, avg_rank - n_masked, 0.0))
+    u = rank_sum_pos - n_pos * (n_pos + 1) / 2
+    return jnp.where((n_pos > 0) & (n_neg > 0), u / (n_pos * n_neg), 0.5)
+
+
+def accuracy(y, score, mask, threshold: float = 0.0):
+    """Fraction of rows with correct sign(score - threshold) prediction."""
+    pred = (score > threshold).astype(jnp.float32)
+    correct = (pred == (y > 0.5)).astype(jnp.float32) * mask
+    return jnp.sum(correct) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def logloss(y, score, mask):
+    """Mean negative log-likelihood of the logistic model; score is the
+    margin (pre-sigmoid)."""
+    # -[y log p + (1-y) log(1-p)] = softplus(score) - y*score, stable form
+    ll = jax.nn.softplus(score) - y * score
+    return jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def logit_objv(y, score, mask):
+    """Sum logistic objective (reference LogitObjv, :66-74) — the objv
+    column of the progress row."""
+    return jnp.sum((jax.nn.softplus(score) - y * score) * mask)
+
+
+def copc(y, score, mask):
+    """Clicks over predicted clicks (reference :76-85)."""
+    clicks = jnp.sum(y * mask)
+    pred = jnp.sum(_sigmoid(score) * mask)
+    return clicks / jnp.maximum(pred, 1e-12)

@@ -1,0 +1,2 @@
+from wormhole_tpu.parallel.mesh import make_mesh, table_sharding, batch_sharding  # noqa: F401
+from wormhole_tpu.parallel.kvstore import KVStore  # noqa: F401

@@ -1,0 +1,126 @@
+"""KVStore: the parameter server, TPU-native.
+
+ps-lite's server group (reference: OnlineServer + per-key Handle state,
+learn/linear/async_sgd.h:200-226; key sharding across `-s` servers) becomes
+a set of fixed-capacity hashed tables living as named-sharded jax Arrays in
+HBM, bucket dimension sharded over the mesh "model" axis:
+
+- ZPull (worker pulls weights for its minibatch's keys,
+  async_sgd.h:277-287)  -> `jnp.take` of bucket rows inside the jitted
+  step; XLA turns the cross-shard gather into ICI collectives.
+- ZPush (worker pushes gradients, key-sharded scatter)  -> segment-sum of
+  per-nonzero contributions into table layout + a sharding constraint, so
+  XLA reduce-scatters gradients onto the owning model shard before the
+  update runs shard-local.
+- server Handle (FTRL/AdaGrad per-key update logic)  -> a functional
+  update step over the state pytree, written by each learner.
+- message filters (fixed-point/compressing transfer,
+  async_sgd.h:290-301)  -> dtype quantization of the pushed gradient.
+
+State is functional: learners thread `store.state` (a dict of arrays)
+through jitted steps and assign back. Save/load uses one npz per model
+shard with the reference's part naming (see utils/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from wormhole_tpu.parallel.mesh import table_sharding
+
+
+@dataclasses.dataclass
+class TableSpec:
+    """One named state table: shape = (num_buckets, *tail)."""
+
+    tail: tuple = ()
+    dtype: object = jnp.float32
+    init: Optional[Callable] = None  # (key, shape, dtype) -> array; 0 if None
+
+
+class KVStore:
+    """Hashed, mesh-sharded parameter/optimizer state tables."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        num_buckets: int,
+        specs: dict[str, TableSpec],
+        seed: int = 0,
+    ):
+        self.mesh = mesh
+        self.num_buckets = int(num_buckets)
+        self.specs = dict(specs)
+        nshards = mesh.shape.get("model", 1)
+        assert self.num_buckets % max(nshards, 1) == 0, (
+            f"num_buckets {num_buckets} must divide over {nshards} model shards"
+        )
+        key = jax.random.PRNGKey(seed)
+        self.state: dict[str, jax.Array] = {}
+        for name, spec in self.specs.items():
+            shape = (self.num_buckets, *spec.tail)
+            sh = table_sharding(mesh, ndim=len(shape))
+            key, sub = jax.random.split(key)
+            if spec.init is None:
+                arr = jax.jit(
+                    lambda: jnp.zeros(shape, spec.dtype), out_shardings=sh
+                )()
+            else:
+                init = spec.init
+                arr = jax.jit(
+                    lambda sub=sub, init=init: init(sub, shape, spec.dtype),
+                    out_shardings=sh,
+                )()
+            self.state[name] = arr
+
+    # -- helpers used inside learner-jitted steps ---------------------------
+    def sharding(self, name: str):
+        return table_sharding(
+            self.mesh, ndim=1 + len(self.specs[name].tail)
+        )
+
+    def constrain(self, name: str, arr):
+        """Pin an intermediate (e.g. a dense gradient in table layout) to
+        the table's sharding so XLA reduce-scatters it to the owning shard
+        (the ZPush key-routing)."""
+        return jax.lax.with_sharding_constraint(arr, self.sharding(name))
+
+    def update(self, new_state: dict[str, jax.Array]) -> None:
+        assert set(new_state) == set(self.state), "state keys changed"
+        self.state = new_state
+
+    # -- host-side views ----------------------------------------------------
+    def nnz(self, name: str = "w") -> int:
+        """|w|_0 — the model-sparsity column of the progress row
+        (reference linear progress.h:10-25 'new_w' tracking)."""
+        return int(jnp.sum(self.state[name] != 0))
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.state.items()}
+
+    def from_numpy(self, arrays: dict[str, np.ndarray]) -> None:
+        for k, v in arrays.items():
+            assert k in self.state, f"unknown table {k}"
+            sh = self.sharding(k)
+            self.state[k] = jax.device_put(jnp.asarray(v), sh)
+
+
+def quantize_push(grad, nbytes: int = 0):
+    """Transfer-filter parity (fixed_bytes knob, reference
+    config.proto:126-133 + FIXING_FLOAT filter): round the pushed gradient
+    to a lower-precision dtype before aggregation. 0 = off, 2 = bfloat16,
+    1 = int8-scaled."""
+    if nbytes == 0:
+        return grad
+    if nbytes >= 2:
+        return grad.astype(jnp.bfloat16).astype(grad.dtype)
+    # 1 byte: per-array absmax int8 scaling
+    scale = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-12) / 127.0
+    q = jnp.clip(jnp.round(grad / scale), -127, 127).astype(jnp.int8)
+    return q.astype(grad.dtype) * scale

@@ -1,0 +1,66 @@
+"""Device mesh: the TPU replacement for Wormhole's worker/server topology.
+
+The reference launches `-n` worker and `-s` server processes (tracker,
+reference doc/common/build.rst:57-71). Here the same two launch dimensions
+become the two axes of a `jax.sharding.Mesh`:
+
+- axis "data"  — data parallelism: minibatches are split across it
+  (the workers);
+- axis "model" — parameter sharding: hashed tables are range-sharded
+  across it (the servers' key shards, localizer.h byte-reversal spreading
+  becomes contiguous range sharding of the hashed bucket space).
+
+Both axes ride ICI within a slice; XLA inserts the collectives (the psum of
+gradients plays rabit::Allreduce, the cross-axis gather plays ZPull).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    num_data: Optional[int] = None,
+    num_model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data x model) mesh. Defaults to all devices on the data
+    axis — the reference's common shape of many workers and fewer servers
+    maps to data-major ordering so neighboring workers share ICI links."""
+    devs = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        num_data = len(devs) // num_model
+    need = num_data * num_model
+    assert need <= len(devs), (
+        f"mesh {num_data}x{num_model} needs {need} devices, have {len(devs)}"
+    )
+    devs = devs[:need]
+    arr = np.array(devs).reshape(num_data, num_model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def table_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Parameter tables: bucket dimension sharded over the model axis
+    (the PS key-shard layout); trailing dims (embedding k) replicated."""
+    return NamedSharding(mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Minibatch arrays: leading dimension split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def single_device_mesh() -> Mesh:
+    """1x1 mesh on the first device — single-chip paths."""
+    return make_mesh(1, 1, devices=jax.devices()[:1])
