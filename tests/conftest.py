@@ -36,10 +36,12 @@ AGARICUS_TEST = "/root/reference/learn/data/agaricus.txt.test"
 
 
 def synth_libsvm_text(n_rows=512, n_feat=1000, nnz_per_row=8, seed=0,
-                      labels01=True):
-    """Synthetic linearly-separable-ish sparse binary data in libsvm text."""
+                      labels01=True, w_seed=1234):
+    """Synthetic linearly-separable-ish sparse binary data in libsvm text.
+    The ground-truth weights come from w_seed so files with different data
+    seeds are drawn from the SAME model (train/val consistency)."""
     rng = np.random.default_rng(seed)
-    w = rng.normal(size=n_feat)
+    w = np.random.default_rng(w_seed).normal(size=n_feat)
     lines = []
     for _ in range(n_rows):
         idx = rng.choice(n_feat, size=nnz_per_row, replace=False)

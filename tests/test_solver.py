@@ -1,0 +1,239 @@
+"""Solver harness tests: workload pool (straggler/failure re-assignment
+with fake workloads, SURVEY §4), full solver loop, checkpoint/resume,
+predict output."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+from wormhole_tpu.parallel.mesh import make_mesh
+from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+from wormhole_tpu.solver.workload import WorkloadPool, WorkType
+from wormhole_tpu.utils import checkpoint as ckpt
+
+from conftest import synth_libsvm_text
+
+
+# ------------------------------------------------------------- pool logic
+def _fake_pool(tmp_path, nfiles=4, nparts=2):
+    for i in range(nfiles):
+        (tmp_path / f"part-{i}").write_text("")
+    pool = WorkloadPool()
+    n = pool.add(str(tmp_path / r"part-\d+"), nparts)
+    assert n == nfiles
+    return pool
+
+
+def test_pool_dispatch_all(tmp_path):
+    pool = _fake_pool(tmp_path)
+    got = []
+    while True:
+        item = pool.get("w0")
+        if item is None:
+            break
+        got.append(item)
+    assert len(got) == 8  # 4 files x 2 parts
+    for pid, f in got:
+        pool.finish(pid)
+    assert pool.is_finished()
+
+
+def test_pool_failure_requeue(tmp_path):
+    """Dead node's parts go back to available (data_parallel.h:131-135)."""
+    pool = _fake_pool(tmp_path)
+    a = pool.get("alive")
+    d1 = pool.get("dead")
+    d2 = pool.get("dead")
+    assert pool.reset("dead") == 2
+    remaining = []
+    while (item := pool.get("alive")) is not None:
+        remaining.append(item)
+    # the 2 re-queued parts are dispatchable again
+    assert len(remaining) == 7
+    assert pool.pending() == 8
+
+
+def test_pool_straggler_requeue(tmp_path):
+    """A job running > max(2 x mean, 5s)... the 5s floor makes real waits
+    slow, so exercise the sample-count gate and the limit math."""
+    pool = _fake_pool(tmp_path, nfiles=6, nparts=2)
+    # fewer than 10 finished -> watchdog must not fire
+    s = pool.get("w0")
+    assert pool.remove_stragglers() == 0
+    pool.finish(s[0])
+    for _ in range(10):
+        pid, _f = pool.get("w0")
+        pool.finish(pid)
+    # one long-running assignment, backdated past the 5s floor
+    pid, _f = pool.get("slow")
+    pool._parts[pid]["t_start"] -= 100.0
+    assert pool.remove_stragglers() == 1
+    # it is available again and finishing the original id is idempotent
+    assert pool.get("w1") is not None
+    pool.finish(pid)
+    pool.finish(pid)
+
+
+def test_pool_finish_after_reassign_no_doublecount(tmp_path):
+    pool = _fake_pool(tmp_path, nfiles=1, nparts=1)
+    pid, _ = pool.get("a")
+    pool.finish(pid)
+    n = pool.num_finished
+    pool.finish(pid)
+    assert pool.num_finished == n
+
+
+# ------------------------------------------------------------- solver loop
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("solver_data")
+    for i in range(3):
+        (d / f"train-part_{i}.libsvm").write_text(
+            synth_libsvm_text(n_rows=400, n_feat=200, nnz_per_row=10,
+                              seed=i))
+    (d / "val-part_0.libsvm").write_text(
+        synth_libsvm_text(n_rows=400, n_feat=200, nnz_per_row=10, seed=99))
+    return d
+
+
+def _cfg(d, tmp_path, **kw):
+    defaults = dict(
+        train_data=str(d / r"train-part_.*\.libsvm"),
+        val_data=str(d / r"val-part_.*\.libsvm"),
+        data_format="libsvm",
+        minibatch=128,
+        num_buckets=1 << 10,
+        nnz_per_row=16,
+        algo="ftrl",
+        lr_eta=0.5,
+        max_data_pass=2,
+        num_parts_per_file=2,
+        model_out=str(tmp_path / "model/out"),
+    )
+    defaults.update(kw)
+    return LinearConfig(**defaults)
+
+
+def test_solver_end_to_end(data_dir, tmp_path):
+    cfg = _cfg(data_dir, tmp_path)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    solver = MinibatchSolver(lrn, cfg, verbose=False)
+    result = solver.run()
+    assert result["train"].value("nex") == 1200
+    assert result["val"].value("nex") == 400
+    assert result["val"].mean("auc") > 0.85
+    assert os.path.exists(str(tmp_path / "model/out_part-0.npz"))
+
+
+def test_solver_model_roundtrip(data_dir, tmp_path):
+    cfg = _cfg(data_dir, tmp_path)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    MinibatchSolver(lrn, cfg, verbose=False).run()
+    val1 = MinibatchSolver(lrn, cfg, verbose=False).iterate(
+        cfg.val_data, WorkType.VAL)
+
+    # fresh learner, load saved model on a DIFFERENT mesh shape
+    cfg2 = _cfg(data_dir, tmp_path, model_in=str(tmp_path / "model/out"),
+                max_data_pass=0)
+    lrn2 = LinearLearner(cfg2, make_mesh(4, 2))
+    MinibatchSolver(lrn2, cfg2, verbose=False).run()
+    val2 = MinibatchSolver(lrn2, cfg2, verbose=False).iterate(
+        cfg.val_data, WorkType.VAL)
+    np.testing.assert_allclose(val1.mean("logloss"), val2.mean("logloss"),
+                               rtol=1e-5)
+
+
+def test_solver_predict_out(data_dir, tmp_path):
+    cfg = _cfg(data_dir, tmp_path, predict_out=str(tmp_path / "pred/out"),
+               max_data_pass=1)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    solver = MinibatchSolver(lrn, cfg, verbose=False)
+    solver.run()
+    # one file per part: 1 val file x 2 parts
+    files = sorted(os.listdir(tmp_path / "pred"))
+    assert len(files) == 2
+    n = sum(len(open(tmp_path / "pred" / f).read().splitlines())
+            for f in files)
+    assert n == 400
+
+
+def test_solver_early_stop(data_dir, tmp_path):
+    cfg = _cfg(data_dir, tmp_path, max_data_pass=10)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    solver = MinibatchSolver(lrn, cfg, verbose=False)
+    calls = []
+
+    def stop(prog, dp, key):
+        calls.append(dp)
+        return dp >= 1  # stop after 2nd pass
+
+    solver.stop_hook = stop
+    solver.run()
+    assert calls == [0, 1]
+
+
+def test_checkpoint_iter_naming(data_dir, tmp_path):
+    cfg = _cfg(data_dir, tmp_path, max_data_pass=4, save_iter=2)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    MinibatchSolver(lrn, cfg, verbose=False).run()
+    names = sorted(os.listdir(tmp_path / "model"))
+    # intermediate save at pass 2 (iter-1) + final
+    assert "out_iter-1_part-0.npz" in names
+    assert "out_part-0.npz" in names
+
+
+def test_checkpoint_reshard_removes_stale_parts(data_dir, tmp_path):
+    """Saving with fewer shards must remove the old extra part files so a
+    later load doesn't concatenate mixed generations."""
+    cfg = _cfg(data_dir, tmp_path, max_data_pass=1)
+    l2 = LinearLearner(cfg, make_mesh(4, 2))  # 2 model shards
+    MinibatchSolver(l2, cfg, verbose=False).run()
+    assert os.path.exists(str(tmp_path / "model/out_part-1.npz"))
+    l1 = LinearLearner(cfg, make_mesh(1, 1))  # 1 shard, same base
+    MinibatchSolver(l1, cfg, verbose=False).run()
+    assert not os.path.exists(str(tmp_path / "model/out_part-1.npz"))
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    ckpt.load_model(lrn.store, str(tmp_path / "model/out"))  # no shape error
+
+
+def test_solver_step_failure_no_thread_leak(data_dir, tmp_path):
+    """A failing train step must not park loader threads forever."""
+    import threading
+
+    cfg = _cfg(data_dir, tmp_path, model_out=None)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad_step(blk):
+        raise Boom()
+
+    lrn.train_batch = bad_step
+    before = threading.active_count()
+    solver = MinibatchSolver(lrn, cfg, verbose=False)
+    with pytest.raises(Boom):
+        solver.run()
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        time.sleep(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before
+
+
+def test_predict_missing_data_raises(data_dir, tmp_path):
+    cfg = _cfg(data_dir, tmp_path)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    solver = MinibatchSolver(lrn, cfg, verbose=False)
+    with pytest.raises(FileNotFoundError):
+        solver.predict(r"/nonexistent/x.*", str(tmp_path / "p/out"))
+
+
+def test_checkpoint_missing_raises(tmp_path):
+    cfg = LinearConfig(num_buckets=64)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_model(lrn.store, str(tmp_path / "nope"))

@@ -107,6 +107,9 @@ class KVStore:
     def from_numpy(self, arrays: dict[str, np.ndarray]) -> None:
         for k, v in arrays.items():
             assert k in self.state, f"unknown table {k}"
+            assert tuple(v.shape) == tuple(self.state[k].shape), (
+                f"table {k}: loaded shape {v.shape} != {self.state[k].shape}"
+            )
             sh = self.sharding(k)
             self.state[k] = jax.device_put(jnp.asarray(v), sh)
 

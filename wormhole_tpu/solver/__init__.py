@@ -1,0 +1,3 @@
+from wormhole_tpu.solver.workload import Workload, WorkloadPool  # noqa: F401
+from wormhole_tpu.solver.progress import Progress  # noqa: F401
+from wormhole_tpu.solver.minibatch_solver import MinibatchSolver  # noqa: F401

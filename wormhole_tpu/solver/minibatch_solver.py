@@ -1,0 +1,197 @@
+"""The train/val/predict pass loop: scheduler + streaming workers.
+
+Parity with reference learn/solver/minibatch_solver.h + iter_solver.h:
+- `run()` drives `max_data_pass` passes of TRAIN then VAL, with model
+  load before (model_in / load_iter) and saves during (save_iter) and
+  after (model_out) — minibatch_solver.h:85-137.
+- each pass dispatches virtual file parts from a WorkloadPool to loader
+  workers (data_parallel.h:93-115); here workers are host threads that
+  parse minibatches into a bounded queue (the max_concurrency
+  backpressure of minibatch_solver.h:284-329) while the main thread runs
+  the jitted device steps — async I/O under synchronous XLA steps.
+- a progress row prints every print_sec (minibatch_solver.h:169-192) and
+  a `stop()` hook supports early stopping (minibatch_solver.h:47-59).
+- predict writes one output file per part (iter_solver.h:140-156).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.solver.progress import Progress
+from wormhole_tpu.solver.workload import WorkloadPool, WorkType
+from wormhole_tpu.utils import checkpoint as ckpt
+
+
+class MinibatchSolver:
+    """Drives a learner (train_batch/eval_batch/predict_batch/store) over
+    sharded files with pooled loading and failure re-queue. The pool's
+    straggler watchdog is NOT started here: within one process, a
+    re-queued part would be read twice and its examples double-trained;
+    the watchdog is for the multi-host scheduler (launcher/dmlc_tpu.py)
+    where a straggling host's parts move to another host."""
+
+    def __init__(self, learner, cfg, num_loaders: int = 2,
+                 max_queued: int = 8, verbose: bool = True):
+        self.learner = learner
+        self.cfg = cfg
+        self.num_loaders = num_loaders
+        self.max_queued = max_queued
+        self.verbose = verbose
+        self.t0 = time.time()
+        # early-stop hook: (pass progress, data_pass, type) -> bool
+        self.stop_hook: Optional[Callable] = None
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> dict:
+        cfg = self.cfg
+        if cfg.model_in:
+            ckpt.load_model(self.learner.store, cfg.model_in,
+                            cfg.load_iter if cfg.load_iter >= 0 else None)
+        result = {}
+        for dp in range(cfg.max_data_pass):
+            tr = self.iterate(cfg.train_data, WorkType.TRAIN, dp)
+            result["train"] = tr
+            if cfg.val_data:
+                vl = self.iterate(cfg.val_data, WorkType.VAL, dp)
+                result["val"] = vl
+            if cfg.model_out and cfg.save_iter > 0 and (
+                (dp + 1) % cfg.save_iter == 0 and dp + 1 < cfg.max_data_pass
+            ):
+                ckpt.save_model(self.learner.store, cfg.model_out, dp)
+            if self._should_stop(result, dp):
+                self._log(f"early stop after pass {dp}")
+                break
+        if cfg.model_out:
+            ckpt.save_model(self.learner.store, cfg.model_out)
+        if getattr(cfg, "predict_out", None):
+            self.predict(cfg.val_data or cfg.train_data, cfg.predict_out)
+        return result
+
+    def _should_stop(self, result: dict, dp: int) -> bool:
+        if self.stop_hook is None:
+            return False
+        key = "val" if "val" in result else "train"
+        return bool(self.stop_hook(result[key], dp, key))
+
+    # ------------------------------------------------------------- iterate
+    def iterate(self, data: str, wtype: WorkType, data_pass: int = 0) -> Progress:
+        cfg = self.cfg
+        pool = WorkloadPool()
+        nfiles = pool.add(data, cfg.num_parts_per_file, cfg.data_format)
+        if nfiles == 0:
+            raise FileNotFoundError(f"no files match {data}")
+        prog = Progress()
+        q: queue.Queue = queue.Queue(maxsize=self.max_queued)
+        _END = object()
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            """Bounded put that gives up when the consumer is gone, so a
+            failed main-thread step can't park loaders on a full queue."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def loader(node_id: int):
+            try:
+                while not stop.is_set():
+                    got = pool.get(f"loader-{node_id}")
+                    if got is None:
+                        return
+                    part_id, f = got
+                    it = MinibatchIter(
+                        f.filename, f.part, f.num_parts, f.format,
+                        minibatch_size=cfg.minibatch,
+                        shuf_buf=(cfg.rand_shuffle * cfg.minibatch
+                                  if wtype == WorkType.TRAIN else 0),
+                        neg_sampling=(cfg.neg_sampling
+                                      if wtype == WorkType.TRAIN else 1.0),
+                        seed=data_pass * 7919 + part_id,
+                    )
+                    for blk in it:
+                        if not _put(blk):
+                            return
+                    pool.finish(part_id)
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                _put(_END)
+
+        threads = [
+            threading.Thread(target=loader, args=(i,), daemon=True)
+            for i in range(self.num_loaders)
+        ]
+        for t in threads:
+            t.start()
+
+        mode = ("train" if wtype == WorkType.TRAIN else "eval")
+        step = (self.learner.train_batch if mode == "train"
+                else self.learner.eval_batch)
+        done_loaders = 0
+        last_print = time.time()
+        if self.verbose:
+            self._log(f"{mode} pass {data_pass}: {data}")
+            self._log(Progress.header())
+        try:
+            while done_loaders < len(threads):
+                item = q.get()
+                if item is _END:
+                    done_loaders += 1
+                    continue
+                prog.merge(step(item))
+                if self.verbose and time.time() - last_print >= cfg.print_sec:
+                    self._log(prog.row(self.t0))
+                    last_print = time.time()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        if errors:
+            raise errors[0]
+        if self.verbose:
+            self._log(prog.row(self.t0))
+        return prog
+
+    # ------------------------------------------------------------- predict
+    def predict(self, data: str, out_base: str) -> list[str]:
+        """One PRED pass; margins written one file per part
+        (iter_solver.h:140-156; users concatenate, criteo_kaggle.rst:97)."""
+        cfg = self.cfg
+        pool = WorkloadPool()
+        if pool.add(data, cfg.num_parts_per_file, cfg.data_format) == 0:
+            raise FileNotFoundError(f"no files match {data}")
+        os.makedirs(os.path.dirname(out_base) or ".", exist_ok=True)
+        out_files = []
+        while True:
+            got = pool.get("predictor")
+            if got is None:
+                break
+            part_id, f = got
+            path = f"{out_base}_part-{part_id}"
+            with open(path, "w") as fh:
+                for blk in MinibatchIter(
+                    f.filename, f.part, f.num_parts, f.format,
+                    minibatch_size=cfg.minibatch,
+                ):
+                    for m in self.learner.predict_batch(blk):
+                        fh.write(f"{m:.6g}\n")
+            out_files.append(path)
+            pool.finish(part_id)
+        return out_files
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(msg, flush=True)

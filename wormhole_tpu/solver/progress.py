@@ -1,0 +1,55 @@
+"""Mergeable progress reporting.
+
+The reference pushes std::vector<double> progress from workers/servers to
+the scheduler's monitor channel, which sums them since the last read and
+prints a row every print_sec (ps::Root/Slave, reference iter_solver.h:62,
+120,164; minibatch_solver.h:169-192). Here the "channel" is in-process:
+learner steps return per-batch metric dicts that merge by summation, and
+the solver prints the same style of row.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class Progress:
+    """Summed metric vector with reference-style row formatting
+    (linear progress.h:10-35: #ex, logloss, acc, auc columns)."""
+
+    def __init__(self):
+        self.tot: dict[str, float] = {}
+        self._last: dict[str, float] = {}
+
+    def merge(self, p: dict) -> None:
+        for k, v in p.items():
+            self.tot[k] = self.tot.get(k, 0.0) + float(v)
+
+    def value(self, key: str) -> float:
+        return self.tot.get(key, 0.0)
+
+    def mean(self, key: str) -> float:
+        n = self.tot.get("nex", 0.0)
+        return self.tot.get(key, 0.0) / n if n else 0.0
+
+    # incremental view: metrics since last row (the reference prints
+    # per-interval increments, criteo_kaggle.rst:66-75)
+    def take_increment(self) -> dict[str, float]:
+        inc = {k: v - self._last.get(k, 0.0) for k, v in self.tot.items()}
+        self._last = dict(self.tot)
+        return inc
+
+    @staticmethod
+    def header() -> str:
+        return (f"{'time':>8} {'#total_ex':>12} {'#inc_ex':>10} "
+                f"{'logloss':>9} {'accuracy':>9} {'auc':>9}")
+
+    def row(self, t0: float) -> str:
+        inc = self.take_increment()
+        n = inc.get("nex", 0.0)
+        def m(k):
+            return inc.get(k, 0.0) / n if n else 0.0
+        return (f"{time.time() - t0:8.1f} {self.tot.get('nex', 0):12.0f} "
+                f"{n:10.0f} {m('logloss'):9.5f} {m('acc'):9.5f} "
+                f"{m('auc'):9.5f}")

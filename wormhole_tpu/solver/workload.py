@@ -1,0 +1,178 @@
+"""Workload descriptors and the scheduler's work-pool.
+
+Parity with reference learn/base/workload.h + workload_pool.h: a Workload
+is a serializable list of (file, part k of n, format) with a pass number
+and TRAIN/VAL/PRED type; the WorkloadPool is the scheduler's thread-safe
+queue of virtual file parts with per-part state (available / assigned /
+done), node affinity for worker-local data, failure re-queue, and a
+straggler watchdog that re-assigns jobs running longer than
+max(2 x mean, 5s) once enough samples exist (workload_pool.h:29-34,176-197).
+
+On TPU the "workers" this pool feeds are host-side data-loading tasks
+(one per device group or per prefetch thread); the pool semantics —
+elastic work stealing, straggler kill, failure re-queue — are unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from enum import IntEnum
+from typing import Callable, Optional
+
+from wormhole_tpu.data.match_file import match_file
+
+
+class WorkType(IntEnum):
+    TRAIN = 1
+    VAL = 2
+    PRED = 3
+
+
+@dataclasses.dataclass
+class File:
+    """One virtual part of one file (workload.h:40-52)."""
+
+    filename: str
+    format: str = "libsvm"
+    part: int = 0
+    num_parts: int = 1
+
+    def __str__(self) -> str:  # debug parity with workload.h ShortDebugString
+        return f"{self.filename} {self.part}/{self.num_parts} ({self.format})"
+
+
+@dataclasses.dataclass
+class Workload:
+    """A unit of work sent to a worker (workload.h:15-38)."""
+
+    files: list = dataclasses.field(default_factory=list)
+    type: WorkType = WorkType.TRAIN
+    data_pass: int = 0
+
+    @property
+    def empty(self) -> bool:
+        return not self.files
+
+
+_STRAGGLER_MIN_SAMPLES = 10
+_STRAGGLER_FLOOR_SEC = 5.0
+
+
+class WorkloadPool:
+    """Thread-safe pool of file parts (workload_pool.h).
+
+    States per part: 0 = available, 1 = assigned, 2 = done. Supports
+    - Add(pattern/files, num_parts_per_file): regex-match + split
+    - Get(node): hand one part to a node (random pick among available)
+    - Finish(part_id): mark done, record duration
+    - Reset(node): re-queue everything a failed node held
+      (the ps-lite node-failure hook path, data_parallel.h:131-135)
+    - straggler watchdog thread (start_straggler_killer)
+    """
+
+    def __init__(self, straggler: bool = False):
+        self._lock = threading.Lock()
+        self._parts: list[dict] = []  # {file, state, node, t_start, time}
+        self._durations: list[float] = []
+        self._straggler = straggler
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.num_finished = 0
+
+    # -- filling ------------------------------------------------------------
+    def add(self, pattern: str, num_parts_per_file: int, fmt: str = "libsvm",
+            shuffle: bool = False, seed: int = 0) -> int:
+        files = match_file(pattern)
+        with self._lock:
+            for f in files:
+                for k in range(num_parts_per_file):
+                    self._parts.append(
+                        dict(file=File(f, fmt, k, num_parts_per_file),
+                             state=0, node=None, t_start=0.0)
+                    )
+            if shuffle:
+                random.Random(seed).shuffle(self._parts)
+            return len(files)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._parts.clear()
+            self._durations.clear()
+            self.num_finished = 0
+
+    # -- dispatch -----------------------------------------------------------
+    def get(self, node: str) -> Optional[tuple[int, File]]:
+        """Assign one available part to `node`; None when nothing avail."""
+        with self._lock:
+            avail = [i for i, p in enumerate(self._parts) if p["state"] == 0]
+            if not avail:
+                return None
+            i = random.choice(avail)
+            p = self._parts[i]
+            p.update(state=1, node=node, t_start=time.monotonic())
+            return i, p["file"]
+
+    def finish(self, part_id: int) -> None:
+        with self._lock:
+            p = self._parts[part_id]
+            if p["state"] == 2:
+                return  # straggler twin already finished it
+            p["state"] = 2
+            self._durations.append(time.monotonic() - p["t_start"])
+            self.num_finished += 1
+
+    def reset(self, node: str) -> int:
+        """Re-queue parts assigned to a dead node; returns count."""
+        n = 0
+        with self._lock:
+            for p in self._parts:
+                if p["state"] == 1 and p["node"] == node:
+                    p.update(state=0, node=None)
+                    n += 1
+        return n
+
+    def is_finished(self) -> bool:
+        with self._lock:
+            return all(p["state"] == 2 for p in self._parts)
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(1 for p in self._parts if p["state"] != 2)
+
+    # -- straggler watchdog -------------------------------------------------
+    def remove_stragglers(self) -> int:
+        """Re-queue assigned parts running > max(2 x mean, 5s); only when
+        >= 10 finished samples exist (workload_pool.h:176-197)."""
+        with self._lock:
+            if len(self._durations) < _STRAGGLER_MIN_SAMPLES:
+                return 0
+            mean = sum(self._durations) / len(self._durations)
+            limit = max(2 * mean, _STRAGGLER_FLOOR_SEC)
+            now = time.monotonic()
+            n = 0
+            for p in self._parts:
+                if p["state"] == 1 and now - p["t_start"] > limit:
+                    p.update(state=0, node=None)
+                    n += 1
+            return n
+
+    def start_straggler_killer(self, interval: float = 2.0) -> None:
+        if self._watchdog is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval):
+                self.remove_stragglers()
+
+        self._watchdog = threading.Thread(target=loop, daemon=True)
+        self._watchdog.start()
+
+    def stop_straggler_killer(self) -> None:
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
+            self._watchdog = None
+        self._stop = threading.Event()

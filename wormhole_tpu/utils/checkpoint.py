@@ -1,0 +1,66 @@
+"""Model checkpoint save/load with the reference's part-file naming.
+
+Parity with reference iter_solver.h:99-119: each server writes its model
+shard to `<base>[_iter-K]_part-<rank>`; load concatenates all parts. Here
+"rank" is the model-axis shard index of each KVStore table, so a
+checkpoint written on an N-shard mesh can be read back on any mesh (parts
+are concatenated on the bucket axis). Arrays are stored as one .npz per
+part. Solver-level resume (load_iter / save_iter, minibatch_solver.h:
+97-133) builds on these names.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+
+def part_name(base: str, it: Optional[int], rank: int) -> str:
+    s = base
+    if it is not None and it >= 0:
+        s += f"_iter-{it}"
+    return s + f"_part-{rank}"
+
+
+def save_model(store, base: str, it: Optional[int] = None) -> list[str]:
+    """Write one npz per model shard (reference SaveModel task fan-out).
+    Stale part files from a previous save with more shards are removed so
+    a later load never concatenates mixed-generation parts."""
+    os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+    prefix = part_name(base, it, 0)[: -len("_part-0")]
+    for old in glob.glob(prefix + "_part-*.npz"):
+        os.remove(old)
+    arrays = store.to_numpy()
+    nshards = store.mesh.shape.get("model", 1)
+    out = []
+    for r in range(nshards):
+        shard = {}
+        for k, v in arrays.items():
+            n = v.shape[0]
+            lo, hi = n * r // nshards, n * (r + 1) // nshards
+            shard[k] = v[lo:hi]
+        path = part_name(base, it, r)
+        np.savez_compressed(path + ".npz", **shard)
+        out.append(path + ".npz")
+    return out
+
+
+def load_model(store, base: str, it: Optional[int] = None) -> None:
+    """Read all part files of a checkpoint into the store (any shard
+    count: parts concatenate on the bucket axis)."""
+    prefix = part_name(base, it, 0)[: -len("_part-0")]
+    paths = sorted(
+        glob.glob(prefix + "_part-*.npz"),
+        key=lambda p: int(re.search(r"_part-(\d+)\.npz$", p).group(1)),
+    )
+    if not paths:
+        raise FileNotFoundError(f"no checkpoint parts match {prefix}_part-*")
+    parts = [dict(np.load(p)) for p in paths]
+    merged = {
+        k: np.concatenate([p[k] for p in parts], axis=0) for k in parts[0]
+    }
+    store.from_numpy(merged)
