@@ -1,0 +1,168 @@
+"""DiFacto FM tests: interaction learning (vs linear), admission
+threshold, grad knobs, checkpoint with both tables, early stop."""
+
+import os
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.data.parsers import parse_libsvm
+from wormhole_tpu.models.difacto import (
+    DifactoConfig,
+    DifactoLearner,
+    make_early_stop_hook,
+)
+from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+from wormhole_tpu.parallel.mesh import make_mesh
+from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+
+
+def fm_synth_text(n_rows=3000, n_a=40, n_b=40, k=3, seed=0):
+    """Labels from a low-rank interaction sign(u_f1 . v_f2): learnable by
+    an FM with dim >= k, not by a linear model (marginals are ~0)."""
+    rng = np.random.default_rng(seed)
+    lat = np.random.default_rng(77)
+    U = lat.normal(size=(n_a, k))
+    Vt = lat.normal(size=(n_b, k))
+    lines = []
+    for _ in range(n_rows):
+        a = rng.integers(n_a)
+        b = rng.integers(n_b)
+        y = 1 if (U[a] * Vt[b]).sum() > 0 else 0
+        lines.append(f"{y} {a}:1 {n_a + b}:1")
+    return "\n".join(lines) + "\n"
+
+
+@pytest.fixture(scope="module")
+def fm_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("fm") / "fm.libsvm"
+    p.write_text(fm_synth_text())
+    return str(p)
+
+
+def _train(lrn, path, passes, mb=256):
+    last = {}
+    for ep in range(passes):
+        tot = {}
+        for blk in MinibatchIter(path, fmt="libsvm", minibatch_size=mb,
+                                 seed=ep):
+            p = lrn.train_batch(blk)
+            for k, v in p.items():
+                tot[k] = tot.get(k, 0.0) + v
+        last = tot
+    return {k: v / last["nex"] for k, v in last.items() if k != "nex"}
+
+
+def test_fm_beats_linear_on_interactions(fm_file):
+    lin = LinearLearner(
+        LinearConfig(minibatch=256, num_buckets=256, nnz_per_row=4,
+                     algo="ftrl", lr_eta=0.5),
+        make_mesh(1, 1))
+    lin_prog = _train(lin, fm_file, passes=6)
+
+    fm = DifactoLearner(
+        DifactoConfig(minibatch=256, num_buckets=256, nnz_per_row=4,
+                      dim=8, threshold=1, lr_eta=0.5, V_lr_eta=0.2,
+                      V_init_scale=0.05),
+        make_mesh(1, 1))
+    fm_prog = _train(fm, fm_file, passes=6)
+
+    assert lin_prog["auc"] < 0.65, "linear should NOT solve interactions"
+    assert fm_prog["auc"] > 0.85, f"FM should: {fm_prog}"
+    assert fm_prog["auc"] > lin_prog["auc"] + 0.2
+
+
+def test_threshold_blocks_embeddings(fm_file):
+    cfg = DifactoConfig(minibatch=256, num_buckets=256, nnz_per_row=4,
+                        dim=4, threshold=10 ** 9, lr_eta=0.5)
+    fm = DifactoLearner(cfg, make_mesh(1, 1))
+    prog = _train(fm, fm_file, passes=3)
+    assert fm.num_admitted() == 0
+    # with V gated off the model is linear -> can't learn interactions
+    assert prog["auc"] < 0.65
+
+
+def test_admission_counts(fm_file):
+    cfg = DifactoConfig(minibatch=256, num_buckets=256, nnz_per_row=4,
+                        dim=4, threshold=5, lr_eta=0.5)
+    fm = DifactoLearner(cfg, make_mesh(1, 1))
+    _train(fm, fm_file, passes=1)
+    # 80 distinct features x ~37 occurrences each >> threshold 5
+    assert fm.num_admitted() == 80
+
+
+def test_grad_knobs_compile(fm_file):
+    cfg = DifactoConfig(minibatch=128, num_buckets=256, nnz_per_row=4,
+                        dim=4, threshold=1, grad_clipping=0.5,
+                        grad_normalization=True, dropout=0.3,
+                        fixed_bytes=2, lambda_V=0.1, l1_shrk=True,
+                        lambda_l1=0.01)
+    fm = DifactoLearner(cfg, make_mesh(1, 1))
+    prog = _train(fm, fm_file, passes=1)
+    assert np.isfinite(prog["logloss"])
+
+
+def test_mesh_equivalence(fm_file):
+    def run(mesh):
+        cfg = DifactoConfig(minibatch=256, num_buckets=256, nnz_per_row=4,
+                            dim=8, threshold=1, lr_eta=0.5, V_lr_eta=0.2,
+                            V_init_scale=0.05)
+        fm = DifactoLearner(cfg, mesh, seed=3)
+        return _train(fm, fm_file, passes=2), fm
+
+    p1, f1 = run(make_mesh(1, 1))
+    p8, f8 = run(make_mesh(4, 2))
+    assert abs(p1["logloss"] - p8["logloss"]) < 2e-3
+    np.testing.assert_allclose(f1.store.to_numpy()["w"],
+                               f8.store.to_numpy()["w"],
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(f1.vstore.to_numpy()["V"],
+                               f8.vstore.to_numpy()["V"],
+                               rtol=2e-2, atol=2e-4)
+
+
+def test_solver_checkpoint_both_tables(fm_file, tmp_path):
+    cfg = DifactoConfig(
+        train_data=fm_file.replace(".libsvm", r"\.libsvm"),
+        minibatch=256, num_buckets=256, nnz_per_row=4, dim=4,
+        threshold=1, max_data_pass=2, num_parts_per_file=2,
+        model_out=str(tmp_path / "m/fm"))
+    fm = DifactoLearner(cfg, make_mesh(1, 1))
+    MinibatchSolver(fm, cfg, verbose=False).run()
+    loaded = dict(np.load(str(tmp_path / "m/fm_part-0.npz")))
+    assert set(loaded) == {"w", "z", "n", "cnt", "V", "nV"}
+    assert loaded["V"].shape == (256, 4)
+
+    # roundtrip: load into fresh learner, eval identical
+    cfg2 = DifactoConfig(**{**cfg.__dict__, "model_in": str(tmp_path / "m/fm"),
+                            "max_data_pass": 0, "model_out": None})
+    fm2 = DifactoLearner(cfg2, make_mesh(4, 2))
+    s2 = MinibatchSolver(fm2, cfg2, verbose=False)
+    s2.run()
+    blk = next(iter(MinibatchIter(fm_file, minibatch_size=256)))
+    np.testing.assert_allclose(fm.predict_batch(blk), fm2.predict_batch(blk),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_early_stop_hook(fm_file, tmp_path):
+    cfg = DifactoConfig(
+        train_data=fm_file.replace(".libsvm", r"\.libsvm"),
+        val_data=fm_file.replace(".libsvm", r"\.libsvm"),
+        minibatch=256, num_buckets=256, nnz_per_row=4, dim=4, threshold=1,
+        max_data_pass=50, early_stop_epsilon=0.5)  # huge eps -> stop early
+    fm = DifactoLearner(cfg, make_mesh(1, 1))
+    solver = MinibatchSolver(fm, cfg, verbose=False)
+    solver.stop_hook = make_early_stop_hook(cfg)
+    solver.run()
+    # big epsilon: second val pass can't improve by 0.5 -> stops at pass 1
+    assert fm._step_count <= 2 * 12 * 2
+
+
+def test_predict_shape(fm_file):
+    cfg = DifactoConfig(minibatch=64, num_buckets=256, nnz_per_row=4,
+                        dim=4, threshold=1)
+    fm = DifactoLearner(cfg, make_mesh(1, 1))
+    blk = parse_libsvm("1 1:1 41:1\n0 2:1 42:1\n")
+    m = fm.predict_batch(blk)
+    assert m.shape == (2,) and np.isfinite(m).all()

@@ -1,0 +1,292 @@
+"""DiFacto: asynchronous factorization machine, TPU-native.
+
+Parity target: reference learn/difacto (async_sgd.h, loss.h, config.proto;
+doc/learn/difacto.rst): the FM model
+
+    f(x) = <w, x> + 1/2 sum_k [ (Xv)_k^2 - (X^2)(V^2)_k ]
+
+with adaptive embedding memory — the reference allocates a key's V slice
+only once its occurrence count reaches `threshold` (and optionally only
+while w != 0, the `l1_shrk` trick, difacto.rst:24-32); w trains with FTRL,
+V with AdaGrad (async_sgd.h:262-296).
+
+TPU design (SURVEY §7.5 two-table plan):
+- `w` (+ FTRL z, n) tables over `num_buckets`, exactly as the linear
+  learner;
+- a separate dense `V` table [v_buckets, dim] (+ AdaGrad nV) with its own
+  (smaller) hashed bucket space — the fixed-capacity stand-in for the
+  reference's variable-length server entries;
+- a `cnt` table accumulates per-bucket occurrence counts in-step (the
+  pass-0 kPushFeaCnt push, async_sgd.h:374-381, becomes a fused
+  segment-sum: the count push and the admission test live in the same
+  jitted step, so no separate count pass is needed);
+- admission = (cnt >= threshold) [* (w != 0) if l1_shrk]; the quadratic
+  term and the V update both see V through the admission mask, so a
+  never-admitted bucket behaves exactly like an unallocated entry.
+- grad dropout / clipping / normalization knobs (loss.h:145-155).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wormhole_tpu.data.rowblock import DeviceBatch, RowBlock, to_device_batch
+from wormhole_tpu.models import linear as linmod
+from wormhole_tpu.ops import metrics as M
+from wormhole_tpu.ops.penalty import l1l2_solve
+from wormhole_tpu.ops.spmv import row_squares, spmm, spmv, spmv_t
+from wormhole_tpu.parallel.kvstore import KVStore, TableSpec, quantize_push
+from wormhole_tpu.parallel.mesh import batch_sharding, make_mesh
+
+
+@dataclasses.dataclass
+class DifactoConfig(linmod.LinearConfig):
+    """Extends the linear config surface with the embedding block of
+    reference difacto config.proto (dim/threshold/lambda/init_scale/
+    dropout/grad_clipping/grad_normalization)."""
+
+    dim: int = 8                 # embedding dimension V_k
+    threshold: int = 2           # occurrence count to admit an embedding
+    l1_shrk: bool = False        # require w != 0 for admission
+    lambda_V: float = 0.01       # l2 on V (AdaGrad update)
+    V_init_scale: float = 0.01   # N(0, scale) init
+    V_lr_eta: float = 0.01
+    V_lr_beta: float = 1.0
+    grad_clipping: float = 0.0   # clip each V grad entry to [-c, c]; 0=off
+    grad_normalization: bool = False  # scale V grad by 1/|batch|
+    dropout: float = 0.0         # zero a fraction of V grads
+    v_buckets: int = 0           # embedding table size; 0 = num_buckets
+    # early stop when val objv improves less than this (async_sgd.h:31-49)
+    early_stop_epsilon: float = 0.0
+
+    @property
+    def vb(self) -> int:
+        return self.v_buckets or self.num_buckets
+
+
+def _tables_for(cfg: DifactoConfig) -> dict[str, TableSpec]:
+    def v_init(key, shape, dtype):
+        return cfg.V_init_scale * jax.random.normal(key, shape, dtype)
+
+    return {
+        "w": TableSpec(),
+        "z": TableSpec(),
+        "n": TableSpec(),
+        "cnt": TableSpec(dtype=jnp.float32),
+        "V": TableSpec(tail=(cfg.dim,), init=v_init),
+        "nV": TableSpec(tail=(cfg.dim,)),
+    }
+
+
+class _CombinedStore:
+    """Checkpoint adapter presenting the w-tables and V-tables as one
+    store (utils/checkpoint.py only needs to_numpy/from_numpy/mesh)."""
+
+    def __init__(self, *stores):
+        self.stores = stores
+        self.mesh = stores[0].mesh
+
+    def to_numpy(self):
+        out = {}
+        for s in self.stores:
+            out.update(s.to_numpy())
+        return out
+
+    def from_numpy(self, arrays):
+        known = set().union(*(s.state for s in self.stores))
+        unknown = set(arrays) - known
+        assert not unknown, f"unknown tables {sorted(unknown)}"
+        for s in self.stores:
+            own = {k: v for k, v in arrays.items() if k in s.state}
+            s.from_numpy(own)
+
+    def nnz(self, name="w"):
+        for s in self.stores:
+            if name in s.state:
+                return s.nnz(name)
+        raise KeyError(name)
+
+
+class DifactoLearner:
+    """Jitted FM train/eval/predict over sharded w and V tables."""
+
+    def __init__(self, cfg: DifactoConfig, mesh=None, seed: int = 0):
+        assert cfg.num_buckets == cfg.vb or cfg.vb < cfg.num_buckets, (
+            "v_buckets must be <= num_buckets")
+        assert cfg.algo == "ftrl", (
+            "difacto trains w with FTRL (reference async_sgd.h:262-286); "
+            f"algo={cfg.algo!r} is not supported here")
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(num_model=1)
+        self.store = KVStore(self.mesh, cfg.num_buckets,
+                             {k: v for k, v in _tables_for(cfg).items()
+                              if v.tail == ()}, seed=seed)
+        # V tables may use a smaller bucket space; keep them in a second
+        # KVStore so each table's bucket axis shards over the model axis
+        self.vstore = KVStore(self.mesh, cfg.vb,
+                              {k: v for k, v in _tables_for(cfg).items()
+                               if v.tail != ()}, seed=seed + 1)
+        self._bsh1 = batch_sharding(self.mesh, 1)
+        self._dropped_rows = 0
+        self._step_count = 0
+        self.ckpt_store = _CombinedStore(self.store, self.vstore)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(state, vstate, seg, idx, vidx, val, label, mask, rngkey):
+            new_state = dict(state)
+            new_vstate = dict(vstate)
+            nb, vb, dim = cfg.num_buckets, cfg.vb, cfg.dim
+
+            # ---- count push + admission (kPushFeaCnt parity) -------------
+            push_cnt = self.store.constrain(
+                "cnt",
+                jax.ops.segment_sum((val != 0).astype(jnp.float32), idx,
+                                    num_segments=nb))
+            cnt = state["cnt"] + push_cnt
+            new_state["cnt"] = cnt
+            admit = (cnt >= cfg.threshold)
+            if cfg.l1_shrk:
+                admit = admit & (state["w"] != 0)
+            admit_f = admit.astype(jnp.float32)
+            # admission lives in w-bucket space; map per-nonzero
+            admit_nz = jnp.take(admit_f, idx)
+
+            # ---- forward -------------------------------------------------
+            w = state["w"]
+            V = vstate["V"]
+            xw = spmv(seg, idx, val, w, label.shape[0])
+            vval = val * admit_nz  # un-admitted keys contribute no V terms
+            xv = spmm(seg, vidx, vval, V, label.shape[0])          # [B, k]
+            x2v2 = row_squares(seg, vidx, vval, V, label.shape[0])  # [B, k]
+            margin = xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
+            obj, d = linmod._loss_dual(cfg.loss, label, margin)
+            d = d * mask
+
+            # ---- gradients ----------------------------------------------
+            gw = spmv_t(seg, idx, val, d, nb)
+            gw = quantize_push(gw, cfg.fixed_bytes)
+            gw = self.store.constrain("w", gw)
+            touched_w = (push_cnt > 0).astype(jnp.float32)
+
+            # dV_j = sum_i d_i x_ij (Xv_i - x_ij V_j)   (loss.h:183-279)
+            d_nz = jnp.take(d, seg) * vval                      # [nnz]
+            xv_nz = jnp.take(xv, seg, axis=0)                   # [nnz, k]
+            v_nz = jnp.take(V, vidx, axis=0)                    # [nnz, k]
+            contrib = d_nz[:, None] * (xv_nz - vval[:, None] * v_nz)
+            gV = jax.ops.segment_sum(contrib, vidx, num_segments=vb)
+            if cfg.grad_normalization:
+                gV = gV / jnp.maximum(jnp.sum(mask), 1.0)
+            if cfg.grad_clipping > 0:
+                gV = jnp.clip(gV, -cfg.grad_clipping, cfg.grad_clipping)
+            if cfg.dropout > 0:
+                keep = jax.random.bernoulli(rngkey, 1.0 - cfg.dropout,
+                                            gV.shape)
+                gV = gV * keep
+            gV = quantize_push(gV, cfg.fixed_bytes)
+            gV = self.vstore.constrain("V", gV)
+            touched_v = self.vstore.constrain(
+                "nV",
+                jax.ops.segment_sum(
+                    admit_nz * (val != 0), vidx, num_segments=vb
+                )[:, None] * jnp.ones((1, dim)),
+            )
+            touched_v = (touched_v > 0).astype(jnp.float32)
+
+            # ---- updates: w by FTRL, V by AdaGrad ------------------------
+            lin_state = {"w": state["w"], "z": state["z"], "n": state["n"]}
+            lin_new = linmod._update("ftrl", lin_state, gw, touched_w, cfg)
+            new_state.update(lin_new)
+
+            nV = vstate["nV"] + touched_v * gV * gV
+            eta = (cfg.V_lr_beta + jnp.sqrt(nV)) / cfg.V_lr_eta
+            V_new = V - touched_v * (gV + cfg.lambda_V * V) / eta
+            new_vstate["V"] = jnp.where(touched_v > 0, V_new, V)
+            new_vstate["nV"] = nV
+
+            prog = linmod._progress(obj, margin, label, mask)
+            obj_w, _ = linmod._loss_dual(cfg.loss, label, xw)
+            prog["objv_w"] = jnp.sum(obj_w * mask)
+            return new_state, new_vstate, prog
+
+        @jax.jit
+        def fwd(state, vstate, seg, idx, vidx, val, label, mask):
+            admit = (state["cnt"] >= cfg.threshold)
+            if cfg.l1_shrk:
+                admit = admit & (state["w"] != 0)
+            admit_nz = jnp.take(admit.astype(jnp.float32), idx)
+            xw = spmv(seg, idx, val, state["w"], label.shape[0])
+            vval = val * admit_nz
+            xv = spmm(seg, vidx, vval, vstate["V"], label.shape[0])
+            x2v2 = row_squares(seg, vidx, vval, vstate["V"], label.shape[0])
+            margin = xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
+            obj, _ = linmod._loss_dual(cfg.loss, label, margin)
+            return margin, linmod._progress(obj, margin, label, mask)
+
+        self._train_step = train_step
+        self._fwd = fwd
+        self._rng = jax.random.PRNGKey(seed + 17)
+
+    # -- plumbing ----------------------------------------------------------
+    def _batch(self, blk: RowBlock):
+        cfg = self.cfg
+        db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
+                             cfg.num_buckets)
+        if db.dropped_rows:
+            self._dropped_rows += db.dropped_rows
+        vidx = (db.idx % np.int32(cfg.vb)).astype(np.int32)
+        put = lambda x: jax.device_put(x, self._bsh1)
+        return (put(db.seg), put(db.idx), put(vidx), put(db.val),
+                put(db.label), put(db.row_mask))
+
+    def train_batch(self, blk: RowBlock) -> dict:
+        self._rng, sub = jax.random.split(self._rng)
+        self.store.state, self.vstore.state, prog = self._train_step(
+            self.store.state, self.vstore.state, *self._batch(blk), sub)
+        self._step_count += 1
+        return jax.tree_util.tree_map(float, prog)
+
+    def eval_batch(self, blk: RowBlock) -> dict:
+        _, prog = self._fwd(self.store.state, self.vstore.state,
+                            *self._batch(blk))
+        return jax.tree_util.tree_map(float, prog)
+
+    def predict_batch(self, blk: RowBlock) -> np.ndarray:
+        margin, _ = self._fwd(self.store.state, self.vstore.state,
+                              *self._batch(blk))
+        return np.asarray(margin)[: blk.size]
+
+    def nnz(self) -> int:
+        return self.store.nnz("w")
+
+    def num_admitted(self) -> int:
+        cnt = np.asarray(self.store.state["cnt"])
+        admit = cnt >= self.cfg.threshold
+        if self.cfg.l1_shrk:
+            admit &= np.asarray(self.store.state["w"]) != 0
+        return int(admit.sum())
+
+
+def make_early_stop_hook(cfg: DifactoConfig):
+    """Early stop when validation objective stops improving by epsilon
+    (reference AsyncScheduler::Stop, difacto async_sgd.h:31-49)."""
+    best = {"objv": None}
+
+    def hook(prog, dp, key) -> bool:
+        if cfg.early_stop_epsilon <= 0 or key != "val":
+            return False
+        objv = prog.mean("logloss")
+        if best["objv"] is not None and (
+            best["objv"] - objv < cfg.early_stop_epsilon
+        ):
+            return True
+        if best["objv"] is None or objv < best["objv"]:
+            best["objv"] = objv
+        return False
+
+    return hook

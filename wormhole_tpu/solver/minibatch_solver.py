@@ -49,11 +49,16 @@ class MinibatchSolver:
         # early-stop hook: (pass progress, data_pass, type) -> bool
         self.stop_hook: Optional[Callable] = None
 
+    @property
+    def _ckpt_store(self):
+        # learners with multiple KV stores expose a combined adapter
+        return getattr(self.learner, "ckpt_store", None) or self.learner.store
+
     # ----------------------------------------------------------------- run
     def run(self) -> dict:
         cfg = self.cfg
         if cfg.model_in:
-            ckpt.load_model(self.learner.store, cfg.model_in,
+            ckpt.load_model(self._ckpt_store, cfg.model_in,
                             cfg.load_iter if cfg.load_iter >= 0 else None)
         result = {}
         for dp in range(cfg.max_data_pass):
@@ -65,12 +70,12 @@ class MinibatchSolver:
             if cfg.model_out and cfg.save_iter > 0 and (
                 (dp + 1) % cfg.save_iter == 0 and dp + 1 < cfg.max_data_pass
             ):
-                ckpt.save_model(self.learner.store, cfg.model_out, dp)
+                ckpt.save_model(self._ckpt_store, cfg.model_out, dp)
             if self._should_stop(result, dp):
                 self._log(f"early stop after pass {dp}")
                 break
         if cfg.model_out:
-            ckpt.save_model(self.learner.store, cfg.model_out)
+            ckpt.save_model(self._ckpt_store, cfg.model_out)
         if getattr(cfg, "predict_out", None):
             self.predict(cfg.val_data or cfg.train_data, cfg.predict_out)
         return result
