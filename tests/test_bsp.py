@@ -1,0 +1,177 @@
+"""BSP learners: k-means, L-BFGS linear, L-BFGS FM (+ OWL-QN, resume)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from wormhole_tpu.models.batch_objectives import (
+    FmObjFunction,
+    LinearObjFunction,
+    load_batches,
+)
+from wormhole_tpu.models.kmeans import KmeansConfig, KmeansLearner
+from wormhole_tpu.parallel.mesh import make_mesh
+from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
+
+from conftest import synth_libsvm_text
+from test_difacto import fm_synth_text
+
+
+# ---------------------------------------------------------------- kmeans
+def _cluster_data(tmp_path, n=1200, d=16, k=3, seed=0):
+    """Three well-separated cones on the unit sphere, sparse-encoded."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d))
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    lines = []
+    truth = []
+    for i in range(n):
+        c = i % k
+        x = centers[c] + 0.05 * rng.normal(size=d)
+        truth.append(c)
+        lines.append("0 " + " ".join(
+            f"{j}:{v:.5f}" for j, v in enumerate(x)))
+    p = tmp_path / "clusters.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p), np.array(truth), centers
+
+
+def test_kmeans_recovers_clusters(tmp_path):
+    path, truth, centers = _cluster_data(tmp_path)
+    cfg = KmeansConfig(train_data=path.replace(".libsvm", r"\.libsvm"),
+                       num_clusters=3, dim=16, max_iter=8, minibatch=256,
+                       nnz_per_row=16,
+                       model_out=str(tmp_path / "centroids.txt"))
+    km = KmeansLearner(cfg, make_mesh(4, 2))
+    cost = km.run(verbose=False)
+    assert cost < 0.05  # tight cones -> tiny mean cosine distance
+    C = np.asarray(km.centroids)
+    Cn = C / np.linalg.norm(C, axis=1, keepdims=True)
+    # every true center has a near-identical learned centroid
+    sims = Cn @ centers.T
+    assert (sims.max(axis=0) > 0.98).all()
+    # text model written (kmeans.cc:212-217 parity)
+    rows = open(tmp_path / "centroids.txt").read().splitlines()
+    assert len(rows) == 3 and len(rows[0].split()) == 16
+
+
+def test_kmeans_cost_decreases(tmp_path):
+    path, _, _ = _cluster_data(tmp_path, seed=5)
+    cfg = KmeansConfig(train_data=path.replace(".libsvm", r"\.libsvm"),
+                       num_clusters=3, dim=16, max_iter=1, minibatch=256,
+                       nnz_per_row=16)
+    km = KmeansLearner(cfg, make_mesh(1, 1))
+    c1 = km.run(verbose=False)
+    km.cfg = KmeansConfig(**{**cfg.__dict__, "max_iter": 6})
+    km.start_iter = 1
+    c6 = km.run(verbose=False)
+    assert c6 <= c1 + 1e-6
+
+
+def test_kmeans_more_clusters_than_rows(tmp_path):
+    """k larger than the candidate row count must still initialize every
+    centroid (jittered reuse) and run to completion."""
+    p = tmp_path / "tiny.libsvm"
+    p.write_text("\n".join(f"0 {i % 4}:1" for i in range(40)) + "\n")
+    cfg = KmeansConfig(train_data=str(p).replace(".libsvm", r"\.libsvm"),
+                       num_clusters=50, dim=8, max_iter=2, minibatch=64,
+                       nnz_per_row=4)
+    km = KmeansLearner(cfg, make_mesh(1, 1))
+    cost = km.run(verbose=False)
+    C = np.asarray(km.centroids)
+    assert C.shape == (50, 8) and np.isfinite(C).all()
+    assert cost < 1e-6  # 4 distinct rows, 50 centroids: perfect cover
+
+
+def test_kmeans_checkpoint_resume(tmp_path):
+    path, _, _ = _cluster_data(tmp_path, seed=7)
+    cdir = str(tmp_path / "ck")
+    cfg = KmeansConfig(train_data=path.replace(".libsvm", r"\.libsvm"),
+                       num_clusters=3, dim=16, max_iter=3, minibatch=256,
+                       nnz_per_row=16, checkpoint_dir=cdir)
+    km = KmeansLearner(cfg, make_mesh(1, 1))
+    km.run(verbose=False)
+    # resume: a new learner picks up at iter 3
+    km2 = KmeansLearner(
+        KmeansConfig(**{**cfg.__dict__, "max_iter": 5}), make_mesh(1, 1))
+    assert km2._try_resume()
+    assert km2.start_iter == 3
+    np.testing.assert_array_equal(np.asarray(km2.centroids),
+                                  np.asarray(km.centroids))
+
+
+# ---------------------------------------------------------------- lbfgs
+@pytest.fixture(scope="module")
+def lin_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("lb") / "lin.libsvm"
+    p.write_text(synth_libsvm_text(n_rows=1500, n_feat=120, nnz_per_row=10,
+                                   seed=11))
+    return str(p)
+
+
+def test_lbfgs_linear_converges(lin_file):
+    mesh = make_mesh(4, 2)
+    batches, nf = load_batches(lin_file.replace(".libsvm", r"\.libsvm"),
+                               mesh, minibatch=512, nnz_per_row=16)
+    obj = LinearObjFunction(batches, nf, mesh)
+    solver = LBFGSSolver(obj, LBFGSConfig(max_iter=25, m=8, reg_l2=1e-3))
+    w, objv = solver.run(verbose=False)
+    n = 1500
+    assert objv / n < 0.25, objv / n  # well below chance logloss 0.693
+    assert solver.objv_history[0] > objv  # monotone improvement overall
+    assert all(b <= a + 1e-6 for a, b in
+               zip(solver.objv_history, solver.objv_history[1:]))
+
+
+def test_lbfgs_owlqn_sparsifies(lin_file):
+    mesh = make_mesh(1, 1)
+    batches, nf = load_batches(lin_file.replace(".libsvm", r"\.libsvm"),
+                               mesh, minibatch=512, nnz_per_row=16)
+    obj = LinearObjFunction(batches, nf, mesh)
+    dense_w, _ = LBFGSSolver(obj, LBFGSConfig(max_iter=20)).run(
+        verbose=False)
+    sparse_w, _ = LBFGSSolver(
+        obj, LBFGSConfig(max_iter=20, reg_l1=30.0)).run(verbose=False)
+    nnz_dense = int(jnp.sum(dense_w[:nf] != 0))
+    nnz_sparse = int(jnp.sum(sparse_w[:nf] != 0))
+    assert nnz_sparse < nnz_dense * 0.7, (nnz_sparse, nnz_dense)
+    # exact zeros, not small values (the OWL-QN orthant projection)
+    assert nnz_sparse < nf
+
+
+def test_lbfgs_checkpoint_resume(lin_file, tmp_path):
+    mesh = make_mesh(1, 1)
+    batches, nf = load_batches(lin_file.replace(".libsvm", r"\.libsvm"),
+                               mesh, minibatch=512, nnz_per_row=16)
+    obj = LinearObjFunction(batches, nf, mesh)
+    cdir = str(tmp_path / "lb_ck")
+    s1 = LBFGSSolver(obj, LBFGSConfig(max_iter=5, checkpoint_dir=cdir))
+    s1.run(verbose=False)
+    s2 = LBFGSSolver(obj, LBFGSConfig(max_iter=10, checkpoint_dir=cdir))
+    w, objv = s2.run(verbose=False)
+    assert s2.iter >= 5  # resumed from iteration 5, not 0
+    assert objv <= s1.objv_history[-1] + 1e-6
+
+
+def test_lbfgs_fm_beats_linear(tmp_path):
+    p = tmp_path / "fm.libsvm"
+    p.write_text(fm_synth_text(n_rows=2000))
+    mesh = make_mesh(2, 1)
+    batches, nf = load_batches(str(p).replace(".libsvm", r"\.libsvm"),
+                               mesh, minibatch=512, nnz_per_row=8)
+    lin = LinearObjFunction(batches, nf, mesh)
+    _, lin_objv = LBFGSSolver(lin, LBFGSConfig(max_iter=15)).run(
+        verbose=False)
+    fm = FmObjFunction(batches, nf, dim_k=6, mesh=mesh, init_scale=0.1)
+    _, fm_objv = LBFGSSolver(
+        fm, LBFGSConfig(max_iter=40, reg_l2=1e-4)).run(verbose=False)
+    # interactions: FM objective far below linear's
+    assert fm_objv < lin_objv * 0.7, (fm_objv, lin_objv)
+
+
+def test_load_batches_missing():
+    with pytest.raises(FileNotFoundError):
+        load_batches(r"/nonexistent/x.*", make_mesh(1, 1))

@@ -70,6 +70,22 @@ class DifactoConfig(linmod.LinearConfig):
         return self.v_buckets or self.num_buckets
 
 
+def _fm_forward(cfg: DifactoConfig, w, V, cnt, seg, idx, vidx, val,
+                num_rows: int):
+    """Admission mask + FM margin, shared by the train and eval steps so
+    the two can never desync. Returns (margin, xw, xv, vval)."""
+    admit = cnt >= cfg.threshold
+    if cfg.l1_shrk:
+        admit = admit & (w != 0)
+    admit_nz = jnp.take(admit.astype(jnp.float32), idx)
+    xw = spmv(seg, idx, val, w, num_rows)
+    vval = val * admit_nz  # un-admitted keys contribute no V terms
+    xv = spmm(seg, vidx, vval, V, num_rows)          # [B, k]
+    x2v2 = row_squares(seg, vidx, vval, V, num_rows)  # [B, k]
+    margin = xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
+    return margin, xw, xv, vval
+
+
 def _tables_for(cfg: DifactoConfig) -> dict[str, TableSpec]:
     def v_init(key, shape, dtype):
         return cfg.V_init_scale * jax.random.normal(key, shape, dtype)
@@ -117,8 +133,8 @@ class DifactoLearner:
     """Jitted FM train/eval/predict over sharded w and V tables."""
 
     def __init__(self, cfg: DifactoConfig, mesh=None, seed: int = 0):
-        assert cfg.num_buckets == cfg.vb or cfg.vb < cfg.num_buckets, (
-            "v_buckets must be <= num_buckets")
+        assert 0 < cfg.vb <= cfg.num_buckets, (
+            f"v_buckets must be in (0, num_buckets]; got {cfg.vb}")
         assert cfg.algo == "ftrl", (
             "difacto trains w with FTRL (reference async_sgd.h:262-286); "
             f"algo={cfg.algo!r} is not supported here")
@@ -150,21 +166,12 @@ class DifactoLearner:
                                     num_segments=nb))
             cnt = state["cnt"] + push_cnt
             new_state["cnt"] = cnt
-            admit = (cnt >= cfg.threshold)
-            if cfg.l1_shrk:
-                admit = admit & (state["w"] != 0)
-            admit_f = admit.astype(jnp.float32)
-            # admission lives in w-bucket space; map per-nonzero
-            admit_nz = jnp.take(admit_f, idx)
 
             # ---- forward -------------------------------------------------
             w = state["w"]
             V = vstate["V"]
-            xw = spmv(seg, idx, val, w, label.shape[0])
-            vval = val * admit_nz  # un-admitted keys contribute no V terms
-            xv = spmm(seg, vidx, vval, V, label.shape[0])          # [B, k]
-            x2v2 = row_squares(seg, vidx, vval, V, label.shape[0])  # [B, k]
-            margin = xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
+            margin, xw, xv, vval = _fm_forward(
+                cfg, w, V, cnt, seg, idx, vidx, val, label.shape[0])
             obj, d = linmod._loss_dual(cfg.loss, label, margin)
             d = d * mask
 
@@ -193,7 +200,7 @@ class DifactoLearner:
             touched_v = self.vstore.constrain(
                 "nV",
                 jax.ops.segment_sum(
-                    admit_nz * (val != 0), vidx, num_segments=vb
+                    (vval != 0).astype(jnp.float32), vidx, num_segments=vb
                 )[:, None] * jnp.ones((1, dim)),
             )
             touched_v = (touched_v > 0).astype(jnp.float32)
@@ -216,15 +223,9 @@ class DifactoLearner:
 
         @jax.jit
         def fwd(state, vstate, seg, idx, vidx, val, label, mask):
-            admit = (state["cnt"] >= cfg.threshold)
-            if cfg.l1_shrk:
-                admit = admit & (state["w"] != 0)
-            admit_nz = jnp.take(admit.astype(jnp.float32), idx)
-            xw = spmv(seg, idx, val, state["w"], label.shape[0])
-            vval = val * admit_nz
-            xv = spmm(seg, vidx, vval, vstate["V"], label.shape[0])
-            x2v2 = row_squares(seg, vidx, vval, vstate["V"], label.shape[0])
-            margin = xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
+            margin, _, _, _ = _fm_forward(
+                cfg, state["w"], vstate["V"], state["cnt"],
+                seg, idx, vidx, val, label.shape[0])
             obj, _ = linmod._loss_dual(cfg.loss, label, margin)
             return margin, linmod._progress(obj, margin, label, mask)
 
@@ -280,7 +281,7 @@ def make_early_stop_hook(cfg: DifactoConfig):
     def hook(prog, dp, key) -> bool:
         if cfg.early_stop_epsilon <= 0 or key != "val":
             return False
-        objv = prog.mean("logloss")
+        objv = prog.mean("objv")  # the trained objective, loss-agnostic
         if best["objv"] is not None and (
             best["objv"] - objv < cfg.early_stop_epsilon
         ):

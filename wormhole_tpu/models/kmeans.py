@@ -1,0 +1,221 @@
+"""Spherical k-means, TPU-native.
+
+Parity target: reference learn/kmeans/kmeans.cc — BSP Lloyd iterations
+with cosine distance: rows are unit-normalized, each rank sums its
+assigned points into a (k x d+1) matrix (count in the last column), the
+matrix is allreduced, and centroids are recomputed by dividing by counts
+(kmeans.cc:169-208); init picks k random rows broadcast from random ranks
+(:89-106); per-iteration checkpoints bound lost work on failure (:204).
+
+TPU design: the assignment pass is two matmuls on the MXU — similarities
+X_hat @ C_hat^T and the accumulation onehot(assign)^T @ [X | 1] — with the
+minibatch sharded over the data axis and the (k x d+1) partial sums
+psum-reduced by XLA (the rabit::Allreduce of kmeans.cc:190). The host
+drives Lloyd iterations and writes a checkpoint per iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
+from wormhole_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+from wormhole_tpu.solver.workload import WorkloadPool
+
+
+@dataclasses.dataclass
+class KmeansConfig:
+    train_data: str = ""
+    data_format: str = "libsvm"
+    num_clusters: int = 10
+    dim: int = 0               # feature-space dim; 0 = discover from data
+    max_iter: int = 10
+    minibatch: int = 4096
+    nnz_per_row: int = 64
+    num_parts_per_file: int = 1
+    model_out: Optional[str] = None
+    checkpoint_dir: Optional[str] = None  # per-iter state for resume
+    seed: int = 0
+
+
+def discover_dim(pattern: str, fmt: str = "libsvm",
+                 num_parts_per_file: int = 1) -> int:
+    """Max feature id + 1 over all files — the Allreduce<Max> dimension
+    discovery of the reference BSP apps (kmeans.cc:160, lbfgs.cc:107-113)."""
+    pool = WorkloadPool()
+    if pool.add(pattern, num_parts_per_file, fmt) == 0:
+        raise FileNotFoundError(f"no files match {pattern}")
+    max_id = -1
+    while (got := pool.get("dim-scan")) is not None:
+        part_id, f = got
+        for blk in MinibatchIter(f.filename, f.part, f.num_parts, f.format,
+                                 minibatch_size=65536):
+            if blk.nnz:
+                max_id = max(max_id, int(blk.index.max()))
+        pool.finish(part_id)
+    return max_id + 1
+
+
+class KmeansLearner:
+    def __init__(self, cfg: KmeansConfig, mesh=None):
+        if cfg.dim == 0:
+            cfg.dim = discover_dim(cfg.train_data, cfg.data_format,
+                                   cfg.num_parts_per_file)
+        assert cfg.dim > 0, "empty data: could not discover dim"
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(num_model=1)
+        self._bsh = batch_sharding(self.mesh, 1)
+        self.centroids: Optional[jax.Array] = None  # [k, d], row-normalized
+        self.start_iter = 0
+
+        k, d, B = cfg.num_clusters, cfg.dim, cfg.minibatch
+
+        @jax.jit
+        def densify(seg, idx, val, mask):
+            """Sparse COO batch -> row-normalized dense [B, d]."""
+            X = jnp.zeros((B, d), jnp.float32).at[seg, idx].add(val)
+            X = X * mask[:, None]
+            norm = jnp.linalg.norm(X, axis=1, keepdims=True)
+            return X / jnp.maximum(norm, 1e-12)
+
+        @jax.jit
+        def assign_accumulate(C, seg, idx, val, mask):
+            """One assignment pass over a batch: returns ([k, d] sums,
+            [k] counts, batch cost). Cosine distance = 1 - X_hat.C_hat."""
+            X = densify(seg, idx, val, mask)
+            Cn = C / jnp.maximum(
+                jnp.linalg.norm(C, axis=1, keepdims=True), 1e-12)
+            sim = X @ Cn.T                                   # MXU [B, k]
+            assign = jnp.argmax(sim, axis=1)
+            best = jnp.max(sim, axis=1)
+            onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32)
+            onehot = onehot * mask[:, None]
+            sums = onehot.T @ X                              # MXU [k, d]
+            counts = jnp.sum(onehot, axis=0)
+            cost = jnp.sum((1.0 - best) * mask)
+            return sums, counts, cost
+
+        self._assign_accumulate = assign_accumulate
+        self._densify = densify
+
+    # -- data plumbing ------------------------------------------------------
+    def _batches(self, seed=0):
+        cfg = self.cfg
+        pool = WorkloadPool()
+        if pool.add(cfg.train_data, cfg.num_parts_per_file,
+                    cfg.data_format) == 0:
+            raise FileNotFoundError(f"no files match {cfg.train_data}")
+        while True:
+            got = pool.get("kmeans")
+            if got is None:
+                return
+            part_id, f = got
+            for blk in MinibatchIter(f.filename, f.part, f.num_parts,
+                                     f.format, minibatch_size=cfg.minibatch,
+                                     seed=seed):
+                if blk.nnz and int(blk.index.max()) >= cfg.dim:
+                    raise ValueError(
+                        f"feature id {int(blk.index.max())} >= dim "
+                        f"{cfg.dim}; set dim=0 to auto-discover")
+                db = to_device_batch(blk, cfg.minibatch,
+                                     cfg.minibatch * cfg.nnz_per_row,
+                                     cfg.dim)
+                put = lambda x: jax.device_put(x, self._bsh)
+                yield (put(db.seg), put(db.idx), put(db.val),
+                       put(db.row_mask))
+            pool.finish(part_id)
+
+    # -- init: random rows (kmeans.cc:89-106) -------------------------------
+    def init_centroids(self) -> None:
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed)
+        rows = []
+        for seg, idx, val, mask in self._batches():
+            X = np.asarray(self._densify(seg, idx, val, mask))
+            n_real = int(np.asarray(mask).sum())
+            take = min(cfg.num_clusters * 4, n_real)
+            rows.append(X[rng.choice(n_real, size=take, replace=False)])
+            if sum(len(r) for r in rows) >= cfg.num_clusters * 8:
+                break
+        cand = np.concatenate(rows)
+        if len(cand) < cfg.num_clusters:
+            # fewer rows than clusters: reuse rows with jitter so every
+            # centroid is initialized (empty clusters resolve in-loop)
+            extra = cand[rng.integers(0, len(cand),
+                                      cfg.num_clusters - len(cand))]
+            extra = extra + 0.01 * rng.standard_normal(extra.shape)
+            cand = np.concatenate([cand, extra.astype(cand.dtype)])
+        # k distinct-ish rows among candidates
+        pick = rng.choice(len(cand), size=cfg.num_clusters, replace=False)
+        self.centroids = jax.device_put(
+            jnp.asarray(cand[pick]), replicated(self.mesh))
+
+    # -- Lloyd loop (kmeans.cc:169-208) -------------------------------------
+    def run(self, verbose: bool = True) -> float:
+        cfg = self.cfg
+        if self.centroids is None and not self._try_resume():
+            self.init_centroids()
+        cost = float("nan")
+        for it in range(self.start_iter, cfg.max_iter):
+            k, d = cfg.num_clusters, cfg.dim
+            sums = jnp.zeros((k, d), jnp.float32)
+            counts = jnp.zeros((k,), jnp.float32)
+            cost_acc = jnp.zeros((), jnp.float32)
+            n = 0
+            for b in self._batches(seed=it):
+                s, c, co = self._assign_accumulate(self.centroids, *b)
+                sums, counts = sums + s, counts + c
+                cost_acc = cost_acc + co
+                n += 1
+            counts_np = counts
+            # empty clusters keep their previous centroid (divide-by-count
+            # only where count > 0)
+            new_C = jnp.where(
+                counts_np[:, None] > 0,
+                sums / jnp.maximum(counts_np[:, None], 1.0),
+                self.centroids,
+            )
+            self.centroids = jax.device_put(new_C, replicated(self.mesh))
+            cost = float(cost_acc) / max(float(jnp.sum(counts)), 1.0)
+            if verbose:
+                print(f"kmeans iter {it}: mean cosine distance {cost:.6f}",
+                      flush=True)
+            if cfg.checkpoint_dir:
+                self._checkpoint(it)
+        if cfg.model_out:
+            self.save(cfg.model_out)
+        return cost
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Text centroids, rank-0-writes-model parity (kmeans.cc:212-217)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        C = np.asarray(self.centroids)
+        with open(path, "w") as f:
+            for row in C:
+                f.write(" ".join(f"{v:.6g}" for v in row) + "\n")
+
+    def _checkpoint(self, it: int) -> None:
+        from wormhole_tpu.utils.checkpoint import atomic_savez
+
+        os.makedirs(self.cfg.checkpoint_dir, exist_ok=True)
+        atomic_savez(os.path.join(self.cfg.checkpoint_dir, "state.npz"),
+                     centroids=np.asarray(self.centroids), next_iter=it + 1)
+
+    def _try_resume(self) -> bool:
+        """LoadCheckPoint parity (kmeans.cc:157-164): resume mid-run."""
+        cdir = self.cfg.checkpoint_dir
+        if not cdir or not os.path.exists(os.path.join(cdir, "state.npz")):
+            return False
+        st = np.load(os.path.join(cdir, "state.npz"))
+        self.centroids = jax.device_put(jnp.asarray(st["centroids"]),
+                                        replicated(self.mesh))
+        self.start_iter = int(st["next_iter"])
+        return True

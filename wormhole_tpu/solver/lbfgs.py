@@ -1,0 +1,237 @@
+"""Batch L-BFGS / OWL-QN solver, TPU-native.
+
+Parity target: reference learn/solver/lbfgs.h — vector-free L-BFGS with
+backtracking line search and OWL-QN L1 handling: the weight vector and its
+2m+1 history basis are partitioned across ranks (lbfgs.h:127-136,557-645),
+global quantities are reconstructed from allreduced dot products
+(:235-303), the line search evaluates the objective via allreduce per
+trial (:321-356), and rabit checkpoints make iterations elastic
+(:120,194).
+
+TPU design: one process drives the whole mesh, so "partitioned across
+ranks" becomes sharding the flat weight/history arrays over the devices;
+jnp.vdot on sharded arrays compiles to local partial dots + psum — the
+same math as the reference's Allreduce of the 5n dot-product Gram matrix,
+with XLA inserting the collective. The objective accumulates over
+device-resident data batches sharded on the data axis. Host Python drives
+the outer iteration and the data-dependent line search (a host loop of
+jitted evals, the analog of the reference's rank-coordinated trials).
+
+OWL-QN specifics (lbfgs.h:358-407): pseudo-gradient at w=0, direction
+sign-fix against the pseudo-gradient, and orthant projection of each
+line-search trial point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Optional, Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ObjFunction(Protocol):
+    """The IObjFunction surface (reference lbfgs.h:23-52)."""
+
+    num_dim: int
+
+    def init_model(self) -> jax.Array: ...
+    def eval(self, w: jax.Array) -> float: ...          # sum loss over data
+    def grad(self, w: jax.Array) -> jax.Array: ...
+    def l1_mask(self) -> jax.Array: ...  # 1 where L1 applies (not bias/V)
+
+
+@dataclasses.dataclass
+class LBFGSConfig:
+    max_iter: int = 30
+    m: int = 10                 # history pairs
+    reg_l1: float = 0.0         # OWL-QN when > 0
+    reg_l2: float = 0.0
+    c1: float = 1e-4            # sufficient-decrease constant
+    rho: float = 0.5            # backtracking factor
+    alpha0: float = 1.0
+    max_linesearch: int = 20
+    min_rel_decrease: float = 1e-7  # convergence: relative objv decrease
+    checkpoint_dir: Optional[str] = None
+
+
+class LBFGSSolver:
+    """Host-driven L-BFGS over device-sharded vectors."""
+
+    def __init__(self, obj: ObjFunction, cfg: LBFGSConfig):
+        self.obj = obj
+        self.cfg = cfg
+        self.S: list[jax.Array] = []   # s_k = w_{k+1} - w_k
+        self.Y: list[jax.Array] = []   # y_k = g_{k+1} - g_k
+        self.iter = 0
+        self.objv_history: list[float] = []
+
+        l2 = cfg.reg_l2
+
+        @jax.jit
+        def full_obj(w, raw_loss):
+            o = raw_loss + 0.5 * l2 * jnp.vdot(w, w)
+            if cfg.reg_l1 > 0:
+                o = o + cfg.reg_l1 * jnp.sum(
+                    jnp.abs(w) * self.obj.l1_mask())
+            return o
+
+        @jax.jit
+        def pseudo_gradient(w, g):
+            """OWL-QN pseudo-gradient of reg_l1*|w| at w (SetL1Dir parity,
+            lbfgs.h:358-378): at w=0 the subgradient closest to zero."""
+            g = g + l2 * w
+            if cfg.reg_l1 <= 0:
+                return g
+            m_ = self.obj.l1_mask()
+            l1 = cfg.reg_l1
+            gp = g + l1 * m_
+            gm = g - l1 * m_
+            pg_zero = jnp.where(gm > 0, gm, jnp.where(gp < 0, gp, 0.0))
+            return jnp.where(
+                (w == 0) & (m_ > 0), pg_zero,
+                g + l1 * jnp.sign(w) * m_)
+
+        @jax.jit
+        def fix_dir_sign(d, pg):
+            """Restrict direction to the descent orthant
+            (FixDirL1Sign, lbfgs.h:380-389)."""
+            return jnp.where(d * -pg > 0, d, 0.0) if cfg.reg_l1 > 0 else d
+
+        @jax.jit
+        def orthant_project(w_new, orthant):
+            """Clip the trial point to the chosen orthant
+            (FixWeightL1Sign, lbfgs.h:391-407)."""
+            if cfg.reg_l1 <= 0:
+                return w_new
+            keep = w_new * orthant >= 0
+            m_ = self.obj.l1_mask()
+            return jnp.where(keep | (m_ == 0), w_new, 0.0)
+
+        self._full_obj = full_obj
+        self._pseudo_gradient = pseudo_gradient
+        self._fix_dir_sign = fix_dir_sign
+        self._orthant_project = orthant_project
+
+    # -- two-loop recursion (lbfgs.h:216-318) --------------------------------
+    def _direction(self, pg: jax.Array) -> jax.Array:
+        q = -pg
+        alphas = []
+        for s, y in zip(reversed(self.S), reversed(self.Y)):
+            rho_i = 1.0 / float(jnp.vdot(y, s))
+            a = rho_i * float(jnp.vdot(s, q))
+            q = q - a * y
+            alphas.append((a, rho_i))
+        if self.S:
+            s, y = self.S[-1], self.Y[-1]
+            gamma = float(jnp.vdot(s, y)) / float(jnp.vdot(y, y))
+            q = q * gamma
+        for (a, rho_i), (s, y) in zip(reversed(alphas),
+                                      zip(self.S, self.Y)):
+            b = rho_i * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        return q
+
+    # -- one iteration (UpdateOneIter, lbfgs.h:168-196) ----------------------
+    def _eval_full(self, w) -> float:
+        return float(self._full_obj(w, self.obj.eval(w)))
+
+    def run(self, verbose: bool = True) -> tuple[jax.Array, float]:
+        cfg = self.cfg
+        w = self._try_resume()
+        resumed = w is not None
+        if not resumed:
+            w = self.obj.init_model()
+        g = self.obj.grad(w)
+        objv = self._eval_full(w)
+        if not resumed:  # resumed history already ends with this objv
+            self.objv_history.append(objv)
+        if verbose:
+            print(f"lbfgs {'resume' if resumed else 'init'}: "
+                  f"objv {objv:.6f}", flush=True)
+
+        while self.iter < cfg.max_iter:
+            pg = self._pseudo_gradient(w, g)
+            d = self._fix_dir_sign(self._direction(pg), pg)
+            # orthant for this step: sign(w), or -sign(pg) where w == 0
+            orthant = jnp.where(w != 0, jnp.sign(w), -jnp.sign(pg))
+
+            # backtracking line search (lbfgs.h:321-356)
+            gd = float(jnp.vdot(pg, d))
+            if gd >= 0:  # not a descent direction: reset history
+                self.S.clear()
+                self.Y.clear()
+                d = -pg
+                gd = float(jnp.vdot(pg, d))
+            alpha = cfg.alpha0
+            w_new, objv_new, ok = w, objv, False
+            for _ in range(cfg.max_linesearch):
+                trial = self._orthant_project(w + alpha * d, orthant)
+                o = self._eval_full(trial)
+                if o <= objv + cfg.c1 * alpha * gd:
+                    w_new, objv_new, ok = trial, o, True
+                    break
+                alpha *= cfg.rho
+            if not ok:
+                if verbose:
+                    print("lbfgs: line search failed, stopping", flush=True)
+                break
+
+            g_new = self.obj.grad(w_new)
+            s = w_new - w
+            y = (g_new + cfg.reg_l2 * w_new) - (g + cfg.reg_l2 * w)
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self.S.append(s)
+                self.Y.append(y)
+                if len(self.S) > cfg.m:
+                    self.S.pop(0)
+                    self.Y.pop(0)
+            rel = (objv - objv_new) / max(abs(objv), 1e-12)
+            w, g, objv = w_new, g_new, objv_new
+            self.iter += 1
+            self.objv_history.append(objv)
+            if verbose:
+                print(f"lbfgs iter {self.iter}: objv {objv:.6f} "
+                      f"alpha {alpha:.3g}", flush=True)
+            self._checkpoint(w)
+            if 0 <= rel < cfg.min_rel_decrease:
+                if verbose:
+                    print("lbfgs: converged", flush=True)
+                break
+        return w, objv
+
+    # -- elastic state (rabit CheckPoint parity, lbfgs.h:120,194) -----------
+    def _checkpoint(self, w) -> None:
+        cdir = self.cfg.checkpoint_dir
+        if not cdir:
+            return
+        from wormhole_tpu.utils.checkpoint import atomic_savez
+
+        os.makedirs(cdir, exist_ok=True)
+        atomic_savez(
+            os.path.join(cdir, "lbfgs_state.npz"),
+            w=np.asarray(w),
+            iter=self.iter,
+            objv=np.asarray(self.objv_history, dtype=np.float64),
+            S=np.stack([np.asarray(s) for s in self.S])
+            if self.S else np.zeros((0, self.obj.num_dim)),
+            Y=np.stack([np.asarray(y) for y in self.Y])
+            if self.Y else np.zeros((0, self.obj.num_dim)),
+        )
+
+    def _try_resume(self):
+        cdir = self.cfg.checkpoint_dir
+        if not cdir:
+            return None
+        path = os.path.join(cdir, "lbfgs_state.npz")
+        if not os.path.exists(path):
+            return None
+        st = np.load(path)
+        self.iter = int(st["iter"])
+        self.objv_history = list(st["objv"])
+        self.S = [jnp.asarray(s) for s in st["S"]]
+        self.Y = [jnp.asarray(y) for y in st["Y"]]
+        return jnp.asarray(st["w"])

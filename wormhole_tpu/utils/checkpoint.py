@@ -19,6 +19,17 @@ from typing import Optional
 import numpy as np
 
 
+def atomic_savez(path: str, compressed: bool = False, **arrays) -> None:
+    """np.savez via temp file + os.replace so a crash mid-write can never
+    leave a truncated checkpoint that bricks resume."""
+    tmp = path + ".tmp"
+    (np.savez_compressed if compressed else np.savez)(tmp, **arrays)
+    # savez appends .npz to paths without the suffix
+    if not tmp.endswith(".npz"):
+        tmp += ".npz"
+    os.replace(tmp, path if path.endswith(".npz") else path + ".npz")
+
+
 def part_name(base: str, it: Optional[int], rank: int) -> str:
     s = base
     if it is not None and it >= 0:
@@ -44,7 +55,7 @@ def save_model(store, base: str, it: Optional[int] = None) -> list[str]:
             lo, hi = n * r // nshards, n * (r + 1) // nshards
             shard[k] = v[lo:hi]
         path = part_name(base, it, r)
-        np.savez_compressed(path + ".npz", **shard)
+        atomic_savez(path + ".npz", compressed=True, **shard)
         out.append(path + ".npz")
     return out
 
