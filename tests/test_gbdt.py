@@ -1,0 +1,211 @@
+"""GBDT learner tests: binning, split math vs brute force, convergence on
+synthetic + agaricus (the reference's xgboost mushroom smoke run), and
+save/load. All run on the 8-device CPU mesh from conftest, so every test
+exercises the row-sharded histogram psum path (dsplit=row parity)."""
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.models.gbdt import (
+    BinnedDataset,
+    GbdtConfig,
+    GbdtLearner,
+    bin_matrix,
+    quantile_edges,
+)
+from tests.conftest import synth_libsvm_text
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+# ---------------------------------------------------------------------------
+# binning
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_edges_few_uniques():
+    X = np.array([[0.0], [1.0], [0.0], [1.0]], np.float32)
+    e = quantile_edges(X, max_bin=256)
+    assert e.shape == (1, 255)
+    # single cut at the midpoint, rest +inf
+    assert e[0, 0] == pytest.approx(0.5)
+    assert np.isinf(e[0, 1:]).all()
+    b = bin_matrix(X, e)
+    assert b[:, 0].tolist() == [0, 1, 0, 1]
+
+
+def test_quantile_edges_many_uniques_monotone():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5000, 3)).astype(np.float32)
+    e = quantile_edges(X, max_bin=16)
+    b = bin_matrix(X, e)
+    assert b.max() < 16
+    # binning must be monotone in the raw value
+    for f in range(3):
+        order = np.argsort(X[:, f], kind="stable")
+        assert (np.diff(b[order, f].astype(int)) >= 0).all()
+    # roughly equal-mass bins
+    counts = np.bincount(b[:, 0], minlength=16)
+    assert counts.min() > 5000 / 16 * 0.5
+
+
+# ---------------------------------------------------------------------------
+# split math: stump vs brute force
+# ---------------------------------------------------------------------------
+
+
+def _brute_force_stump(binned, g, h, lam, gamma, mcw, max_bin):
+    """Best (feature, bin) by exhaustive search with the xgboost gain."""
+    n, F = binned.shape
+    G, H = g.sum(), h.sum()
+    best = (-np.inf, 0, 0)
+    for f in range(F):
+        for b in range(max_bin - 1):
+            left = binned[:, f] <= b
+            GL, HL = g[left].sum(), h[left].sum()
+            GR, HR = G - GL, H - HL
+            if HL < mcw or HR < mcw:
+                continue
+            gain = 0.5 * (GL * GL / (HL + lam) + GR * GR / (HR + lam)
+                          - G * G / (H + lam)) - gamma
+            if gain > best[0]:
+                best = (gain, f, b)
+    return best
+
+
+def test_stump_matches_brute_force(tmp_path):
+    rng = np.random.default_rng(3)
+    n, F = 512, 6
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 2] + 0.3 * X[:, 4] + 0.1 * rng.normal(size=n) > 0).astype(int)
+    lines = "\n".join(
+        f"{y[i]} " + " ".join(f"{f}:{X[i, f]:.5f}" for f in range(F))
+        for i in range(n)
+    )
+    train = _write(tmp_path, "t.libsvm", lines + "\n")
+    cfg = GbdtConfig(train_data=train, max_depth=1, num_round=1, eta=1.0,
+                     gamma=0.0, min_child_weight=1.0, reg_lambda=1.0,
+                     max_bin=32)
+    lrn = GbdtLearner(cfg)
+    lrn.fit(verbose=False)
+    # reproduce: base margin 0 -> g = 0.5 - y, h = 0.25
+    ds = lrn.load_dataset(train)
+    binned = np.asarray(ds.binned)[: ds.num_real]
+    g = 0.5 - y.astype(np.float64)
+    h = np.full(n, 0.25)
+    gain, bf, bb = _brute_force_stump(binned, g, h, 1.0, 0.0, 1.0, 32)
+    assert gain > 0
+    assert int(lrn.trees["split_feat"][0][0]) == bf
+    assert int(lrn.trees["split_bin"][0][0]) == bb
+    # leaf values: -G/(H+lam) * eta on each side
+    left = binned[:, bf] <= bb
+    for node, m in ((1, left), (2, ~left)):
+        expect = -g[m].sum() / (h[m].sum() + 1.0)
+        assert lrn.trees["leaf_value"][0][node] == pytest.approx(
+            expect, rel=1e-4)
+
+
+def test_pure_leaf_when_no_gain(tmp_path):
+    # constant labels: every split has zero gain -> root becomes a leaf
+    lines = "\n".join("1 0:1 1:2" for _ in range(64))
+    train = _write(tmp_path, "c.libsvm", lines + "\n")
+    cfg = GbdtConfig(train_data=train, max_depth=3, num_round=1, eta=1.0,
+                     gamma=0.0)
+    lrn = GbdtLearner(cfg)
+    lrn.fit(verbose=False)
+    assert not lrn.trees["is_split"][0].any()
+    assert lrn.trees["leaf_value"][0][0] != 0.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end convergence
+# ---------------------------------------------------------------------------
+
+
+def test_synth_convergence(tmp_path):
+    train = _write(tmp_path, "tr.libsvm",
+                   synth_libsvm_text(n_rows=800, n_feat=40, seed=0))
+    val = _write(tmp_path, "va.libsvm",
+                 synth_libsvm_text(n_rows=400, n_feat=40, seed=1))
+    cfg = GbdtConfig(train_data=train, eval_data=val, eval_train=1,
+                     max_depth=4, num_round=20, eta=0.3, reg_lambda=1.0,
+                     max_bin=32)
+    lrn = GbdtLearner(cfg)
+    res = lrn.fit(verbose=False)
+    assert res["train"]["error"] < 0.05
+    assert res["test"]["error"] < 0.25
+    assert res["test"]["auc"] > 0.8
+
+
+def test_agaricus_mushroom_conf(agaricus, tmp_path):
+    """The reference's smoke run: mushroom.hadoop.conf settings (eta=1,
+    gamma=1, min_child_weight=1, max_depth=3, num_round=2) reach ~1-2%
+    error on agaricus — the xgboost demo's published trajectory."""
+    train, test = agaricus
+    cfg = GbdtConfig(train_data=train, eval_data=test, eval_train=1,
+                     eta=1.0, gamma=1.0, min_child_weight=1.0, max_depth=3,
+                     num_round=2)
+    lrn = GbdtLearner(cfg)
+    res = lrn.fit(verbose=False)
+    assert res["train"]["error"] < 0.03
+    assert res["test"]["error"] < 0.03
+
+
+def test_squarederror(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 400
+    x = rng.normal(size=n).astype(np.float32)
+    y = 2.0 * x + 1.0
+    lines = "\n".join(f"{y[i]:.5f} 0:{x[i]:.5f}" for i in range(n))
+    train = _write(tmp_path, "r.libsvm", lines + "\n")
+    cfg = GbdtConfig(train_data=train, objective="reg:squarederror",
+                     eval_train=1, max_depth=4, num_round=30, eta=0.3,
+                     base_score=0.0, max_bin=64)
+    lrn = GbdtLearner(cfg)
+    res = lrn.fit(verbose=False)
+    assert res["train"]["rmse"] < 0.2
+
+
+# ---------------------------------------------------------------------------
+# persistence + predict
+# ---------------------------------------------------------------------------
+
+
+def test_save_load_predict(tmp_path, agaricus):
+    train, test = agaricus
+    model = str(tmp_path / "gbdt_model")
+    cfg = GbdtConfig(train_data=train, max_depth=3, num_round=3, eta=0.5,
+                     model_out=model)
+    lrn = GbdtLearner(cfg)
+    lrn.fit(verbose=False)
+
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.data.rowblock import RowBlock
+
+    blk = RowBlock.concat(list(MinibatchIter(test, 0, 1, "libsvm",
+                                             minibatch_size=10000)))
+    p1 = lrn.predict_blk(blk)
+
+    lrn2 = GbdtLearner(GbdtConfig())
+    lrn2.load(model)
+    p2 = lrn2.predict_blk(blk)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+    # predictions are probabilities that actually separate the classes
+    err = np.mean((p1 > 0.5) != (blk.label > 0.5))
+    assert err < 0.05
+
+
+def test_save_period_writes_intermediate(tmp_path):
+    train = _write(tmp_path, "tr.libsvm", synth_libsvm_text(n_rows=200))
+    model = str(tmp_path / "m")
+    cfg = GbdtConfig(train_data=train, max_depth=2, num_round=4,
+                     save_period=2, model_out=model)
+    GbdtLearner(cfg).fit(verbose=False)
+    import os
+
+    assert os.path.exists(model + ".0002.npz")
+    assert os.path.exists(model + ".npz")

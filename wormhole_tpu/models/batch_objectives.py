@@ -28,10 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.data.rowblock import to_device_batch
 from wormhole_tpu.parallel.mesh import batch_sharding
-from wormhole_tpu.solver.workload import WorkloadPool
+from wormhole_tpu.solver.workload import iter_rowblocks
 
 
 def load_batches(pattern: str, mesh, fmt: str = "libsvm",
@@ -40,31 +39,21 @@ def load_batches(pattern: str, mesh, fmt: str = "libsvm",
     """Read all data into device-resident fixed-shape batches; returns
     (batches, num_feature) with num_feature = max id + 1 over all shards
     (the Allreduce<Max> of lbfgs.cc:107-113)."""
-    pool = WorkloadPool()
-    if pool.add(pattern, num_parts_per_file, fmt) == 0:
-        raise FileNotFoundError(f"no files match {pattern}")
     bsh = batch_sharding(mesh, 1)
     batches = []
     max_id = -1
-    while True:
-        got = pool.get("loader")
-        if got is None:
-            break
-        part_id, f = got
-        for blk in MinibatchIter(f.filename, f.part, f.num_parts, f.format,
-                                 minibatch_size=minibatch):
-            if blk.nnz:
-                max_id = max(max_id, int(blk.index.max()))
-            # raw column ids, no hash kernel (batch solvers use the true
-            # feature space like the reference's RowBlockIter path); ids
-            # must fit the device index dtype
-            assert max_id < 2 ** 31 - 1, "batch objectives need int32 ids"
-            db = to_device_batch(blk, minibatch, minibatch * nnz_per_row,
-                                 2 ** 31 - 1)
-            put = lambda x: jax.device_put(x, bsh)
-            batches.append((put(db.seg), put(db.idx), put(db.val),
-                            put(db.label), put(db.row_mask)))
-        pool.finish(part_id)
+    for blk in iter_rowblocks(pattern, num_parts_per_file, fmt, minibatch):
+        if blk.nnz:
+            max_id = max(max_id, int(blk.index.max()))
+        # raw column ids, no hash kernel (batch solvers use the true
+        # feature space like the reference's RowBlockIter path); ids
+        # must fit the device index dtype
+        assert max_id < 2 ** 31 - 1, "batch objectives need int32 ids"
+        db = to_device_batch(blk, minibatch, minibatch * nnz_per_row,
+                             2 ** 31 - 1)
+        put = lambda x: jax.device_put(x, bsh)
+        batches.append((put(db.seg), put(db.idx), put(db.val),
+                        put(db.label), put(db.row_mask)))
     return batches, max_id + 1
 
 
@@ -114,10 +103,13 @@ class LinearObjFunction(_BatchObjBase):
         self.num_dim = num_feature + 1
         super().__init__(batches, mesh)
 
-    def _batch_loss(self, p, seg, idx, val, label, mask):
+    def _margin(self, p, seg, idx, val, num_rows: int):
         w, bias = p[: self.num_feature], p[self.num_feature]
-        xw = jax.ops.segment_sum(val * jnp.take(w, idx), seg,
-                                 num_segments=label.shape[0]) + bias
+        return jax.ops.segment_sum(val * jnp.take(w, idx), seg,
+                                   num_segments=num_rows) + bias
+
+    def _batch_loss(self, p, seg, idx, val, label, mask):
+        xw = self._margin(p, seg, idx, val, label.shape[0])
         return jnp.sum((jax.nn.softplus(xw) - label * xw) * mask)
 
     def init_model(self):
@@ -128,9 +120,7 @@ class LinearObjFunction(_BatchObjBase):
         return m.at[self.num_feature].set(0.0)  # no L1 on bias
 
     def predict(self, p, seg, idx, val, num_rows: int):
-        w, bias = p[: self.num_feature], p[self.num_feature]
-        return jax.ops.segment_sum(val * jnp.take(w, idx), seg,
-                                   num_segments=num_rows) + bias
+        return self._margin(p, seg, idx, val, num_rows)
 
 
 class FmObjFunction(_BatchObjBase):
@@ -149,17 +139,19 @@ class FmObjFunction(_BatchObjBase):
         d, k = self.num_feature, self.k
         return p[:d], p[d : d + d * k].reshape(d, k), p[-1]
 
-    def _batch_loss(self, p, seg, idx, val, label, mask):
+    def _margin(self, p, seg, idx, val, num_rows: int):
         w, V, bias = self._split(p)
-        B = label.shape[0]
         xw = jax.ops.segment_sum(val * jnp.take(w, idx), seg,
-                                 num_segments=B)
+                                 num_segments=num_rows)
         vrows = jnp.take(V, idx, axis=0)
         xv = jax.ops.segment_sum(val[:, None] * vrows, seg,
-                                 num_segments=B)
+                                 num_segments=num_rows)
         x2v2 = jax.ops.segment_sum((val ** 2)[:, None] * vrows ** 2, seg,
-                                   num_segments=B)
-        margin = xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1) + bias
+                                   num_segments=num_rows)
+        return xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1) + bias
+
+    def _batch_loss(self, p, seg, idx, val, label, mask):
+        margin = self._margin(p, seg, idx, val, label.shape[0])
         return jnp.sum((jax.nn.softplus(margin) - label * margin) * mask)
 
     def init_model(self):
@@ -176,12 +168,4 @@ class FmObjFunction(_BatchObjBase):
         return m.at[: self.num_feature].set(1.0)
 
     def predict(self, p, seg, idx, val, num_rows: int):
-        w, V, bias = self._split(p)
-        xw = jax.ops.segment_sum(val * jnp.take(w, idx), seg,
-                                 num_segments=num_rows)
-        vrows = jnp.take(V, idx, axis=0)
-        xv = jax.ops.segment_sum(val[:, None] * vrows, seg,
-                                 num_segments=num_rows)
-        x2v2 = jax.ops.segment_sum((val ** 2)[:, None] * vrows ** 2, seg,
-                                   num_segments=num_rows)
-        return xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1) + bias
+        return self._margin(p, seg, idx, val, num_rows)

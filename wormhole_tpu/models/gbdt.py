@@ -1,0 +1,535 @@
+"""Histogram gradient-boosted decision trees, TPU-native.
+
+Parity target: the reference's distributed xgboost build — `bin/xgboost.dmlc`
+run over rabit with row-split data (reference Makefile:63-72,
+learn/xgboost/mushroom.hadoop.conf). The conf surface kept is exactly the
+mushroom conf's: booster=gbtree, objective=binary:logistic, eta, gamma,
+min_child_weight, max_depth, num_round, save_period, eval_train, dsplit=row,
+plus lambda (leaf L2) and max_bin.
+
+TPU design (vs the reference's CPU allreduce xgboost):
+- features are quantile-binned once on the host into a dense uint8 matrix
+  [rows, features]; rows are sharded over the mesh data axis (dsplit=row);
+- tree growth is depth-wise: one jitted step per level builds the
+  (node, feature, bin) gradient/hessian histograms with a flat
+  segment-sum, `psum`s them over the data axis — the literal TPU analog
+  of distributed xgboost's rabit::Allreduce of histograms — then scans
+  cumulative G/H over bins to score every candidate split at once
+  (gain = 1/2[GL^2/(HL+l) + GR^2/(HR+l) - G^2/(H+l)] - gamma) and routes
+  rows to children, all with static shapes;
+- trees are heap-indexed arrays (split_feat/split_bin/is_split/leaf_value)
+  replicated over the mesh; prediction is a `fori_loop` of gathers scanned
+  over rounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from wormhole_tpu.data.rowblock import RowBlock
+from wormhole_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated,
+)
+from wormhole_tpu.solver.workload import iter_rowblocks
+
+
+@dataclasses.dataclass
+class GbdtConfig:
+    """mushroom.hadoop.conf surface (names kept; `lambda` -> reg_lambda)."""
+
+    train_data: str = ""
+    eval_data: Optional[str] = None   # conf key eval[<name>] = path
+    eval_name: str = "test"
+    data_format: str = "libsvm"
+    model_out: Optional[str] = None
+    model_in: Optional[str] = None
+
+    booster: str = "gbtree"
+    objective: str = "binary:logistic"   # or reg:squarederror
+    eta: float = 0.3
+    gamma: float = 0.0
+    min_child_weight: float = 1.0
+    max_depth: int = 6
+    reg_lambda: float = 1.0              # xgboost `lambda`
+    num_round: int = 10
+    save_period: int = 0
+    eval_train: int = 0
+    dsplit: str = "row"                  # only row split is supported
+    base_score: float = 0.5
+
+    # TPU-native knobs
+    max_bin: int = 256
+    dim: int = 0        # feature count; 0 = discover from data
+    minibatch: int = 65536  # streaming-load chunk size
+    num_parts_per_file: int = 1
+    seed: int = 0
+
+
+# ---------------------------------------------------------------------------
+# host-side dataset loading + quantile binning
+# ---------------------------------------------------------------------------
+
+_SKETCH_ROWS = 1 << 17  # quantile-sketch sample cap (approx sketch parity)
+
+
+def _load_rowblocks(pattern: str, fmt: str, num_parts_per_file: int,
+                    minibatch: int) -> RowBlock:
+    blocks = list(iter_rowblocks(pattern, num_parts_per_file, fmt,
+                                 minibatch, node="gbdt-load"))
+    if not blocks:
+        raise ValueError(f"no rows in {pattern}")
+    return RowBlock.concat(blocks)
+
+
+def _densify(blk: RowBlock, dim: int) -> np.ndarray:
+    """Sparse CSR rows -> dense [n, dim] float32 (absent feature = 0,
+    matching xgboost's default missing=0 treatment for libsvm data)."""
+    n = blk.size
+    X = np.zeros((n, dim), np.float32)
+    rows = np.repeat(np.arange(n), np.diff(blk.offset).astype(np.int64))
+    cols = blk.index.astype(np.int64)
+    keep = cols < dim
+    X[rows[keep], cols[keep]] = blk.values_or_ones()[keep]
+    return X
+
+
+def quantile_edges(X: np.ndarray, max_bin: int) -> np.ndarray:
+    """Per-feature cut points, [dim, max_bin-1], padded with +inf.
+
+    bin(x) = searchsorted(edges, x, 'right'); few distinct values get
+    midpoint cuts, many get quantile cuts — the histogram/approx sketch
+    of xgboost, computed on a host sample."""
+    dim = X.shape[1]
+    edges = np.full((dim, max_bin - 1), np.inf, np.float32)
+    for f in range(dim):
+        col = X[:, f]
+        uniq = np.unique(col)
+        if len(uniq) <= 1:
+            continue
+        if len(uniq) <= max_bin:
+            cuts = (uniq[:-1] + uniq[1:]) / 2.0
+        else:
+            qs = np.quantile(col, np.linspace(0, 1, max_bin + 1)[1:-1])
+            cuts = np.unique(qs.astype(np.float32))
+        edges[f, : len(cuts)] = cuts
+    return edges
+
+
+def bin_matrix(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Apply cut points -> uint8 bins [n, dim]."""
+    n, dim = X.shape
+    out = np.empty((n, dim), np.uint8)
+    for f in range(dim):
+        e = edges[f]
+        e = e[np.isfinite(e)]
+        out[:, f] = np.searchsorted(e, X[:, f], side="right").astype(np.uint8)
+    return out
+
+
+@dataclasses.dataclass
+class BinnedDataset:
+    """Device-resident binned dataset, rows sharded over the data axis."""
+
+    binned: jax.Array   # uint8 [N, dim]  (N padded to mesh data size)
+    label: jax.Array    # float32 [N]
+    mask: jax.Array     # float32 [N]  (0 for padding rows)
+    num_real: int
+
+
+# ---------------------------------------------------------------------------
+# learner
+# ---------------------------------------------------------------------------
+
+
+class GbdtLearner:
+    """Depth-wise histogram GBDT over a (data,) sharded row matrix."""
+
+    def __init__(self, cfg: GbdtConfig, mesh=None):
+        if cfg.booster != "gbtree":
+            raise NotImplementedError(
+                f"booster={cfg.booster!r}: only gbtree; for gblinear use "
+                "wormhole_tpu.models.linear (the reference's gblinear is a "
+                "distributed linear model)")
+        if cfg.dsplit != "row":
+            raise NotImplementedError("only dsplit=row (the reference "
+                                      "mushroom.hadoop.conf:36 setting)")
+        assert cfg.max_bin <= 256, "bins are uint8"
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(num_model=1)
+        self._n_data = self.mesh.shape[DATA_AXIS]
+        self.edges: Optional[np.ndarray] = None   # [dim, max_bin-1]
+        # stacked per-round trees, each [T] where T = 2^(max_depth+1)-1
+        self.trees: dict[str, np.ndarray] = _empty_trees(cfg)
+        self._level_fns: dict = {}
+        self._jit_cache: dict = {}
+
+    # -- data ---------------------------------------------------------------
+    def load_dataset(self, pattern: str, fit_bins: bool = False) -> BinnedDataset:
+        cfg = self.cfg
+        blk = _load_rowblocks(pattern, cfg.data_format,
+                              cfg.num_parts_per_file, cfg.minibatch)
+        if cfg.dim == 0:
+            # Allreduce<Max> dimension discovery parity (lbfgs.cc:107-113)
+            cfg.dim = int(blk.index.max()) + 1 if blk.nnz else 1
+        if fit_bins or self.edges is None:
+            rng = np.random.default_rng(cfg.seed)
+            take = min(blk.size, _SKETCH_ROWS)
+            rows = (np.arange(blk.size) if take == blk.size
+                    else rng.choice(blk.size, take, replace=False))
+            sample = _densify(_take_rows(blk, np.sort(rows)), cfg.dim)
+            self.edges = quantile_edges(sample, cfg.max_bin)
+        # bin in chunks to bound host memory
+        n = blk.size
+        binned = np.empty((n, cfg.dim), np.uint8)
+        step = max(1, cfg.minibatch)
+        for lo in range(0, n, step):
+            sub = blk.slice(lo, min(lo + step, n))
+            binned[lo : lo + sub.size] = bin_matrix(
+                _densify(sub, cfg.dim), self.edges)
+        # pad rows to a multiple of the data axis
+        pad = (-n) % self._n_data
+        if pad:
+            binned = np.concatenate([binned, np.zeros((pad, cfg.dim), np.uint8)])
+        label = np.zeros(n + pad, np.float32)
+        label[:n] = blk.label
+        mask = np.zeros(n + pad, np.float32)
+        mask[:n] = 1.0
+        b1 = batch_sharding(self.mesh, 1)
+        b2 = batch_sharding(self.mesh, 2)
+        return BinnedDataset(
+            binned=jax.device_put(binned, b2),
+            label=jax.device_put(label, b1),
+            mask=jax.device_put(mask, b1),
+            num_real=n,
+        )
+
+    # -- objective ----------------------------------------------------------
+    def _grad_hess(self, margin, label, mask):
+        obj = self.cfg.objective
+        if obj == "binary:logistic":
+            p = jax.nn.sigmoid(margin)
+            return (p - label) * mask, jnp.maximum(p * (1 - p), 1e-16) * mask
+        if obj in ("reg:squarederror", "reg:linear"):
+            return (margin - label) * mask, mask
+        raise NotImplementedError(f"objective={obj!r}")
+
+    def _base_margin(self):
+        if self.cfg.objective == "binary:logistic":
+            s = min(max(self.cfg.base_score, 1e-6), 1 - 1e-6)
+            return float(np.log(s / (1 - s)))
+        return float(self.cfg.base_score)
+
+    # -- per-level jitted step ---------------------------------------------
+    def _hyper_key(self):
+        """Cache key component for every cfg field a compiled fn closes
+        over, so mutating cfg (e.g. via load()) can never reuse stale
+        compilations."""
+        c = self.cfg
+        return (c.dim, c.max_bin, c.max_depth, c.reg_lambda, c.gamma,
+                c.min_child_weight, c.eta, c.objective)
+
+    def _level_fn(self, num_nodes: int, offset: int, last: bool):
+        key = (num_nodes, offset, last, self._hyper_key())
+        fn = self._level_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        F, B = cfg.dim, cfg.max_bin
+        lam, gam, mcw, eta = (cfg.reg_lambda, cfg.gamma,
+                              cfg.min_child_weight, cfg.eta)
+        mesh = self.mesh
+
+        def local_hist(binned, g, h, rel):
+            """Per-shard (node, feature, bin) histograms + psum — the
+            rabit::Allreduce of gradient histograms."""
+            n = g.shape[0]
+            base = rel[:, None] * (F * B) + jnp.arange(F, dtype=jnp.int32)[None, :] * B
+            idx = base + binned.astype(jnp.int32)          # [n, F]
+            # inactive rows got rel == num_nodes -> index >= num_segments,
+            # dropped by the scatter (OOB updates are discarded)
+            gb = jnp.broadcast_to(g[:, None], (n, F)).ravel()
+            hb = jnp.broadcast_to(h[:, None], (n, F)).ravel()
+            flat = idx.ravel()
+            G = jax.ops.segment_sum(gb, flat, num_segments=num_nodes * F * B)
+            H = jax.ops.segment_sum(hb, flat, num_segments=num_nodes * F * B)
+            G = jax.lax.psum(G, DATA_AXIS)
+            H = jax.lax.psum(H, DATA_AXIS)
+            return (G.reshape(num_nodes, F, B), H.reshape(num_nodes, F, B))
+
+        hist = jax.shard_map(
+            local_hist, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(P(), P()),
+        )
+
+        @jax.jit
+        def level_step(binned, g, h, node, active, trees):
+            rel = jnp.where(active, node - offset, num_nodes).astype(jnp.int32)
+            G, H = hist(binned, g, h, rel)
+            Gt, Ht = G[:, 0, :].sum(-1), H[:, 0, :].sum(-1)   # node totals
+            leaf = -Gt / (Ht + lam) * eta
+            sl = slice(offset, offset + num_nodes)
+            if last:
+                trees = dict(trees)
+                trees["leaf_value"] = trees["leaf_value"].at[sl].set(leaf)
+                return node, jnp.zeros_like(active), trees
+            # candidate splits: left = bins <= b (cumulative), right = rest
+            GL = jnp.cumsum(G, axis=2)
+            HL = jnp.cumsum(H, axis=2)
+            GR, HR = Gt[:, None, None] - GL, Ht[:, None, None] - HL
+            gain = 0.5 * (GL * GL / (HL + lam) + GR * GR / (HR + lam)
+                          - (Gt * Gt / (Ht + lam))[:, None, None]) - gam
+            ok = (HL >= mcw) & (HR >= mcw)
+            ok = ok & (jnp.arange(B) < B - 1)[None, None, :]
+            gain = jnp.where(ok, gain, -jnp.inf)
+            flat_gain = gain.reshape(num_nodes, F * B)
+            best = jnp.argmax(flat_gain, axis=1)
+            best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
+            do_split = best_gain > 0.0
+            bf = (best // B).astype(jnp.int32)
+            bb = (best % B).astype(jnp.int32)
+            trees = dict(trees)
+            trees["split_feat"] = trees["split_feat"].at[sl].set(bf)
+            trees["split_bin"] = trees["split_bin"].at[sl].set(bb)
+            trees["is_split"] = trees["is_split"].at[sl].set(do_split)
+            trees["leaf_value"] = trees["leaf_value"].at[sl].set(
+                jnp.where(do_split, 0.0, leaf))
+            # route rows into children
+            nf = trees["split_feat"][node]
+            thr = trees["split_bin"][node]
+            bv = jnp.take_along_axis(
+                binned.astype(jnp.int32), nf[:, None], axis=1)[:, 0]
+            splitting = trees["is_split"][node] & active
+            node = jnp.where(splitting,
+                             2 * node + 1 + (bv > thr).astype(jnp.int32),
+                             node)
+            return node, splitting, trees
+
+        self._level_fns[key] = level_step
+        return level_step
+
+    # -- boosting -----------------------------------------------------------
+    def _build_tree(self, ds: BinnedDataset, g, h):
+        cfg = self.cfg
+        T = 2 ** (cfg.max_depth + 1) - 1
+        rep = replicated(self.mesh)
+        trees = {
+            "split_feat": jax.device_put(jnp.zeros(T, jnp.int32), rep),
+            "split_bin": jax.device_put(jnp.zeros(T, jnp.int32), rep),
+            "is_split": jax.device_put(jnp.zeros(T, jnp.bool_), rep),
+            "leaf_value": jax.device_put(jnp.zeros(T, jnp.float32), rep),
+        }
+        node = jnp.zeros(ds.label.shape, jnp.int32)
+        node = jax.device_put(node, batch_sharding(self.mesh, 1))
+        active = ds.mask > 0
+        for d in range(cfg.max_depth + 1):
+            num_nodes, offset = 2 ** d, 2 ** d - 1
+            fn = self._level_fn(num_nodes, offset, last=(d == cfg.max_depth))
+            node, active, trees = fn(ds.binned, g, h, node, active, trees)
+        return trees, node
+
+    def _round_fns(self):
+        fns = self._jit_cache.get("round")
+        if fns is None:
+            gh = jax.jit(lambda m, y, msk: self._grad_hess(m, y, msk))
+            upd = jax.jit(lambda m, lv, node: m + lv[node])
+            fns = self._jit_cache["round"] = (gh, upd)
+        return fns
+
+    def fit(self, verbose: bool = True) -> dict:
+        """The boosting loop; prints `[round] name-metric:value` rows like
+        the reference xgboost CLI."""
+        cfg = self.cfg
+        train = self.load_dataset(cfg.train_data, fit_bins=True)
+        evals = []
+        if cfg.eval_data:
+            evals.append((cfg.eval_name, self.load_dataset(cfg.eval_data)))
+        if cfg.eval_train:
+            evals.append(("train", train))
+        gh, upd = self._round_fns()
+        margin = jnp.full(train.label.shape, self._base_margin(), jnp.float32)
+        margin = jax.device_put(margin, batch_sharding(self.mesh, 1))
+        margins = {name: None for name, _ in evals}
+        last = {}
+        for r in range(cfg.num_round):
+            g, hss = gh(margin, train.label, train.mask)
+            tree, node = self._build_tree(train, g, hss)
+            for k in self.trees:
+                self.trees[k][r] = np.asarray(tree[k])
+            margin = upd(margin, tree["leaf_value"], node)
+            msgs = []
+            for name, ds in evals:
+                if ds is train:
+                    em = margin
+                else:
+                    prev = margins[name]
+                    em = self._apply_tree(ds, tree) if prev is None else \
+                        upd(prev, tree["leaf_value"],
+                            self._route(ds, tree))
+                    margins[name] = em
+                last[name] = m = self._metrics(em, ds)
+                msgs += [f"{name}-{k}:{v:.6f}" for k, v in m.items()]
+            if verbose:
+                print(f"[{r}]\t" + "\t".join(msgs), flush=True)
+            if cfg.save_period and cfg.model_out and (r + 1) % cfg.save_period == 0:
+                self.save(f"{cfg.model_out}.{r + 1:04d}", rounds=r + 1)
+        if cfg.model_out:
+            self.save(cfg.model_out)
+        return last
+
+    # -- eval / predict -----------------------------------------------------
+    def _route(self, ds: BinnedDataset, tree):
+        key = ("route", ds.binned.shape, self.cfg.max_depth)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            depth = self.cfg.max_depth
+
+            @jax.jit
+            def route(binned, sf, sb, isp):
+                node = jnp.zeros(binned.shape[0], jnp.int32)
+
+                def body(_, node):
+                    f = sf[node]
+                    bv = jnp.take_along_axis(
+                        binned.astype(jnp.int32), f[:, None], 1)[:, 0]
+                    child = 2 * node + 1 + (bv > sb[node]).astype(jnp.int32)
+                    return jnp.where(isp[node], child, node)
+
+                return jax.lax.fori_loop(0, depth + 1, body, node)
+
+            fn = self._jit_cache[key] = route
+        return fn(ds.binned, tree["split_feat"], tree["split_bin"],
+                  tree["is_split"])
+
+    def _apply_tree(self, ds: BinnedDataset, tree):
+        base = jnp.full(ds.label.shape, self._base_margin(), jnp.float32)
+        node = self._route(ds, tree)
+        return base + tree["leaf_value"][node]
+
+    def _metrics(self, margin, ds: BinnedDataset) -> dict:
+        from wormhole_tpu.ops import metrics as M
+
+        key = ("metrics", margin.shape, self._hyper_key())
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            if self.cfg.objective == "binary:logistic":
+
+                @jax.jit
+                def mfn(margin, label, mask):
+                    return {
+                        "error": 1.0 - M.accuracy(label, margin, mask),
+                        "logloss": M.logloss(label, margin, mask),
+                        "auc": M.auc(label, margin, mask),
+                    }
+            else:
+
+                @jax.jit
+                def mfn(margin, label, mask):
+                    n = jnp.maximum(jnp.sum(mask), 1.0)
+                    return {"rmse": jnp.sqrt(
+                        jnp.sum(mask * (margin - label) ** 2) / n)}
+
+            fn = self._jit_cache[key] = mfn
+        return {k: float(v) for k, v in
+                fn(margin, ds.label, ds.mask).items()}
+
+    def predict_margin(self, ds: BinnedDataset, num_round: Optional[int] = None
+                       ) -> np.ndarray:
+        R = num_round if num_round is not None else self.cfg.num_round
+        m = jnp.full(ds.label.shape, self._base_margin(), jnp.float32)
+        for r in range(R):
+            tree = {k: jnp.asarray(v[r]) for k, v in self.trees.items()}
+            m = m + tree["leaf_value"][self._route(ds, tree)]
+        return np.asarray(m)[: ds.num_real]
+
+    def predict_blk(self, blk: RowBlock) -> np.ndarray:
+        """Predict probabilities (binary:logistic) / values on raw rows."""
+        assert self.edges is not None, "model not fit/loaded"
+        X = _densify(blk, self.cfg.dim)
+        binned = bin_matrix(X, self.edges)
+        pad = (-blk.size) % self._n_data
+        if pad:
+            binned = np.concatenate(
+                [binned, np.zeros((pad, self.cfg.dim), np.uint8)])
+        ds = BinnedDataset(
+            binned=jax.device_put(binned, batch_sharding(self.mesh, 2)),
+            label=jnp.zeros(blk.size + pad, jnp.float32),
+            mask=jnp.concatenate([jnp.ones(blk.size), jnp.zeros(pad)]),
+            num_real=blk.size,
+        )
+        m = self.predict_margin(ds)
+        if self.cfg.objective == "binary:logistic":
+            return 1.0 / (1.0 + np.exp(-m))
+        return m
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str, rounds: Optional[int] = None) -> None:
+        from wormhole_tpu.utils.checkpoint import atomic_savez
+
+        R = rounds if rounds is not None else self.cfg.num_round
+        R = min(R, len(self.trees["leaf_value"]))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        atomic_savez(
+            path,
+            edges=self.edges,
+            num_round=R,
+            dim=self.cfg.dim,
+            max_depth=self.cfg.max_depth,
+            objective=np.bytes_(self.cfg.objective.encode()),
+            base_score=self.cfg.base_score,
+            **{k: v[:R] for k, v in self.trees.items()},
+        )
+
+    def load(self, path: str) -> None:
+        if not os.path.exists(path) and not path.endswith(".npz"):
+            path += ".npz"  # atomic_savez appends the suffix
+        st = np.load(path)
+        self.edges = st["edges"]
+        self.cfg.dim = int(st["dim"])
+        self.cfg.max_depth = int(st["max_depth"])
+        self.cfg.num_round = int(st["num_round"])
+        self.cfg.objective = bytes(st["objective"]).decode()
+        self.cfg.base_score = float(st["base_score"])
+        self.trees = {k: np.array(st[k]) for k in
+                      ("split_feat", "split_bin", "is_split", "leaf_value")}
+
+
+def _empty_trees(cfg: GbdtConfig) -> dict[str, np.ndarray]:
+    T = 2 ** (cfg.max_depth + 1) - 1
+    R = cfg.num_round
+    return {
+        "split_feat": np.zeros((R, T), np.int32),
+        "split_bin": np.zeros((R, T), np.int32),
+        "is_split": np.zeros((R, T), np.bool_),
+        "leaf_value": np.zeros((R, T), np.float32),
+    }
+
+
+def _take_rows(blk: RowBlock, rows: np.ndarray) -> RowBlock:
+    """Gather a sorted row subset of a RowBlock (for the quantile sample)."""
+    lens = np.diff(blk.offset).astype(np.int64)[rows]
+    off = np.zeros(len(rows) + 1, np.int64)
+    np.cumsum(lens, out=off[1:])
+    idx = np.concatenate([
+        np.arange(blk.offset[r], blk.offset[r] + lens[i])
+        for i, r in enumerate(rows)
+    ]) if len(rows) else np.zeros(0, np.int64)
+    return RowBlock(
+        label=blk.label[rows],
+        offset=off,
+        index=blk.index[idx],
+        value=None if blk.value is None else blk.value[idx],
+        weight=None if blk.weight is None else blk.weight[rows],
+    )

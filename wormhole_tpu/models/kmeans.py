@@ -24,10 +24,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
 from wormhole_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
-from wormhole_tpu.solver.workload import WorkloadPool
+from wormhole_tpu.solver.workload import iter_rowblocks
 
 
 @dataclasses.dataclass
@@ -49,17 +48,11 @@ def discover_dim(pattern: str, fmt: str = "libsvm",
                  num_parts_per_file: int = 1) -> int:
     """Max feature id + 1 over all files — the Allreduce<Max> dimension
     discovery of the reference BSP apps (kmeans.cc:160, lbfgs.cc:107-113)."""
-    pool = WorkloadPool()
-    if pool.add(pattern, num_parts_per_file, fmt) == 0:
-        raise FileNotFoundError(f"no files match {pattern}")
     max_id = -1
-    while (got := pool.get("dim-scan")) is not None:
-        part_id, f = got
-        for blk in MinibatchIter(f.filename, f.part, f.num_parts, f.format,
-                                 minibatch_size=65536):
-            if blk.nnz:
-                max_id = max(max_id, int(blk.index.max()))
-        pool.finish(part_id)
+    for blk in iter_rowblocks(pattern, num_parts_per_file, fmt,
+                              node="dim-scan"):
+        if blk.nnz:
+            max_id = max(max_id, int(blk.index.max()))
     return max_id + 1
 
 
@@ -108,29 +101,19 @@ class KmeansLearner:
     # -- data plumbing ------------------------------------------------------
     def _batches(self, seed=0):
         cfg = self.cfg
-        pool = WorkloadPool()
-        if pool.add(cfg.train_data, cfg.num_parts_per_file,
-                    cfg.data_format) == 0:
-            raise FileNotFoundError(f"no files match {cfg.train_data}")
-        while True:
-            got = pool.get("kmeans")
-            if got is None:
-                return
-            part_id, f = got
-            for blk in MinibatchIter(f.filename, f.part, f.num_parts,
-                                     f.format, minibatch_size=cfg.minibatch,
-                                     seed=seed):
-                if blk.nnz and int(blk.index.max()) >= cfg.dim:
-                    raise ValueError(
-                        f"feature id {int(blk.index.max())} >= dim "
-                        f"{cfg.dim}; set dim=0 to auto-discover")
-                db = to_device_batch(blk, cfg.minibatch,
-                                     cfg.minibatch * cfg.nnz_per_row,
-                                     cfg.dim)
-                put = lambda x: jax.device_put(x, self._bsh)
-                yield (put(db.seg), put(db.idx), put(db.val),
-                       put(db.row_mask))
-            pool.finish(part_id)
+        for blk in iter_rowblocks(cfg.train_data, cfg.num_parts_per_file,
+                                  cfg.data_format, cfg.minibatch,
+                                  node="kmeans", seed=seed):
+            if blk.nnz and int(blk.index.max()) >= cfg.dim:
+                raise ValueError(
+                    f"feature id {int(blk.index.max())} >= dim "
+                    f"{cfg.dim}; set dim=0 to auto-discover")
+            db = to_device_batch(blk, cfg.minibatch,
+                                 cfg.minibatch * cfg.nnz_per_row,
+                                 cfg.dim)
+            put = lambda x: jax.device_put(x, self._bsh)
+            yield (put(db.seg), put(db.idx), put(db.val),
+                   put(db.row_mask))
 
     # -- init: random rows (kmeans.cc:89-106) -------------------------------
     def init_centroids(self) -> None:
@@ -174,12 +157,11 @@ class KmeansLearner:
                 sums, counts = sums + s, counts + c
                 cost_acc = cost_acc + co
                 n += 1
-            counts_np = counts
             # empty clusters keep their previous centroid (divide-by-count
             # only where count > 0)
             new_C = jnp.where(
-                counts_np[:, None] > 0,
-                sums / jnp.maximum(counts_np[:, None], 1.0),
+                counts[:, None] > 0,
+                sums / jnp.maximum(counts[:, None], 1.0),
                 self.centroids,
             )
             self.centroids = jax.device_put(new_C, replicated(self.mesh))

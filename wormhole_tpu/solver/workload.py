@@ -57,6 +57,25 @@ class Workload:
         return not self.files
 
 
+def iter_rowblocks(pattern: str, num_parts_per_file: int = 1,
+                   fmt: str = "libsvm", minibatch_size: int = 65536,
+                   node: str = "loader", seed: int = 0):
+    """Drain a one-shot WorkloadPool over `pattern`, yielding RowBlocks —
+    the shared pool.add -> get -> MinibatchIter -> finish protocol used by
+    every batch learner (the reference's RowBlockIter(rank, world) path,
+    kmeans.cc:149-154, lbfgs.cc:229-234)."""
+    from wormhole_tpu.data.minibatch import MinibatchIter
+
+    pool = WorkloadPool()
+    if pool.add(pattern, num_parts_per_file, fmt) == 0:
+        raise FileNotFoundError(f"no files match {pattern}")
+    while (got := pool.get(node)) is not None:
+        part_id, f = got
+        yield from MinibatchIter(f.filename, f.part, f.num_parts, f.format,
+                                 minibatch_size=minibatch_size, seed=seed)
+        pool.finish(part_id)
+
+
 _STRAGGLER_MIN_SAMPLES = 10
 _STRAGGLER_FLOOR_SEC = 5.0
 
