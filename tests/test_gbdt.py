@@ -199,6 +199,27 @@ def test_save_load_predict(tmp_path, agaricus):
     assert err < 0.05
 
 
+def test_model_in_continuation(tmp_path):
+    """model_in warm start: 2 rounds then 2 more must equal 4 straight
+    rounds (deterministic greedy trees => identical models)."""
+    train = _write(tmp_path, "tr.libsvm",
+                   synth_libsvm_text(n_rows=400, n_feat=30, seed=2))
+    m1 = str(tmp_path / "m1")
+    base = dict(train_data=train, max_depth=3, eta=0.5, max_bin=32)
+    GbdtLearner(GbdtConfig(num_round=2, model_out=m1, **base)).fit(
+        verbose=False)
+    m2 = str(tmp_path / "m2")
+    GbdtLearner(GbdtConfig(num_round=2, model_in=m1, model_out=m2,
+                           **base)).fit(verbose=False)
+    ref = GbdtLearner(GbdtConfig(num_round=4, **base))
+    ref.fit(verbose=False)
+    cont = GbdtLearner(GbdtConfig())
+    cont.load(m2)
+    assert cont.cfg.num_round == 4
+    for k in ref.trees:
+        np.testing.assert_allclose(cont.trees[k], ref.trees[k], atol=1e-5)
+
+
 def test_save_period_writes_intermediate(tmp_path):
     train = _write(tmp_path, "tr.libsvm", synth_libsvm_text(n_rows=200))
     model = str(tmp_path / "m")

@@ -1,0 +1,168 @@
+"""Native C++ core vs the pure-Python reference implementations.
+
+The contract: the ctypes-bound parsers and CityHash64 in
+wormhole_tpu/native must be bit-identical to wormhole_tpu/data/parsers.py
+and wormhole_tpu/ops/hashing.py on every format. The native library is
+built on demand by the fixture; if the toolchain is missing the module
+falls back to Python and these tests skip."""
+
+import numpy as np
+import pytest
+
+from wormhole_tpu import native
+from wormhole_tpu.data import parsers as P
+from wormhole_tpu.ops.hashing import cityhash64 as py_cityhash64
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not native.available():
+        pytest.skip("native library unavailable (no toolchain?)")
+    return native.get_lib()
+
+
+def _assert_blocks_equal(a, b):
+    np.testing.assert_array_equal(a.label, b.label)
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_array_equal(a.index, b.index)
+    if a.value is None or b.value is None:
+        assert a.value is None and b.value is None
+    else:
+        np.testing.assert_allclose(a.value, b.value, rtol=1e-6)
+
+
+def test_cityhash64_matches_python(lib):
+    cases = [b"", b"a", b"ab", b"abc", b"abcd", b"hello", b"12345678",
+             b"123456789", b"x" * 16, b"x" * 17, b"x" * 32, b"y" * 33,
+             b"z" * 64, b"w" * 65, b"q" * 128, b"r" * 200,
+             "unicode-ключ".encode(), b"\x00\x01\x02"]
+    rng = np.random.default_rng(0)
+    for n in [5, 13, 21, 40, 63, 70, 129, 1000]:
+        cases.append(bytes(rng.integers(0, 256, n, dtype=np.uint8)))
+    for s in cases:
+        assert native.cityhash64(s) == py_cityhash64(s), s
+
+
+def test_libsvm_parity(lib):
+    text = (
+        "1 3:1 7:2.5 100:0.001\n"
+        "0 1:1 2:1\n"
+        "\n"
+        "# a comment line\n"
+        "-1 5:-3.5 6:1e-3\n"
+        "1.5 42:1\n"
+    )
+    _assert_blocks_equal(native.parse_text(text, "libsvm"),
+                         P.parse_libsvm(text))
+
+
+def test_libsvm_binary_compaction(lib):
+    text = "1 3:1 7:1\n0 1:1\n"
+    a = native.parse_text(text, "libsvm")
+    b = P.parse_libsvm(text)
+    assert a.value is None and b.value is None
+    _assert_blocks_equal(a, b)
+
+
+def test_libsvm_agaricus_full_file(lib):
+    import os
+
+    path = "/root/reference/learn/data/agaricus.txt.train"
+    if not os.path.exists(path):
+        pytest.skip("agaricus not mounted")
+    text = open(path).read()
+    a = native.parse_text(text, "libsvm")
+    b = P.parse_libsvm(text)
+    assert a.size == 6513 and a.nnz == 143286  # known file shape
+    _assert_blocks_equal(a, b)
+
+
+def test_criteo_parity(lib):
+    text = (
+        "1\t4\t\t12\t0\t\t3\t\t\t\t\t5\t1\t\t68fd1e64\t80e26c9b\tfb936136"
+        "\t7b4723c4\t25c83c98\t7e0ccccf\tde7995b8\t1f89b562\ta73ee510"
+        "\ta8cd5504\tb2cb9c98\t37c9c164\t2824a5f6\t1adce6ef\t8ba8b39a"
+        "\t891b62e7\te5ba7672\tf54016b9\t21ddcdc9\tb1252a9d\t07b5194c"
+        "\t\t3a171ecb\tc5c50484\te8b83407\t9727dd16\n"
+        "0\t1\t2\t\t\t\t\t\t\t\t\t\t\t\tabc\tdef\t\t\t\t\t\t\t\t\t\t\t\t\t"
+        "\t\t\t\t\t\t\t\t\t\t\n"
+    )
+    _assert_blocks_equal(native.parse_text(text, "criteo"),
+                         P.parse_criteo(text, has_label=True))
+    _assert_blocks_equal(native.parse_text(text, "criteo_test"),
+                         P.parse_criteo(text, has_label=False))
+
+
+def test_adfea_parity(lib):
+    text = (
+        "10001 3 1 12345:1 678901:2 42:3\n"
+        "10002 2 0 999:1 1048577:1023\n"
+        "bad line\n"
+        "10003 1 -1 7:0\n"
+    )
+    _assert_blocks_equal(native.parse_text(text, "adfea"),
+                         P.parse_adfea(text))
+
+
+def test_parse_text_dispatch_uses_native(lib, monkeypatch):
+    """parse_text must actually route through native.parse_text and fall
+    back to the Python parser when native declines."""
+    text = "1 3:1 7:2.5\n0 1:1\n"
+    calls = []
+    real = native.parse_text
+
+    def spy(t, f):
+        calls.append(f)
+        return real(t, f)
+
+    monkeypatch.setattr(native, "parse_text", spy)
+    via_dispatch = P.parse_text(text, "libsvm")
+    assert calls == ["libsvm"], "dispatch did not use the native path"
+    _assert_blocks_equal(via_dispatch, P.parse_libsvm(text))
+
+    # native declines (returns None) -> python fallback must serve it
+    monkeypatch.setattr(native, "parse_text", lambda t, f: None)
+    _assert_blocks_equal(P.parse_text(text, "libsvm"), P.parse_libsvm(text))
+
+
+def test_malformed_input_raises_not_hangs(lib):
+    """Python parsers raise on malformed lines; the native path must do
+    the same — never loop, never fabricate values."""
+    for text, fmt in [
+        ("1 abc\n", "libsvm"),          # non-numeric token
+        ("xyz 1:1\n", "libsvm"),        # non-numeric label
+        ("1 3:\n0 1:1\n", "libsvm"),    # trailing ':' eats next line
+        ("1 3:abc\n", "libsvm"),        # garbage value
+        ("10001 1 zz 7:1\n", "adfea"),  # non-numeric label
+        ("10001 1 1 x:1\n", "adfea"),   # non-numeric fid
+    ]:
+        with pytest.raises(ValueError):
+            blk = native.parse_text(text, fmt)
+            assert blk is not None  # None would mask the test
+    # python reference behavior on the same inputs
+    with pytest.raises(ValueError):
+        P.parse_libsvm("1 3:\n0 1:1\n")
+    with pytest.raises(ValueError):
+        P.parse_adfea("10001 1 zz 7:1\n")
+
+
+def test_native_throughput_exceeds_python(lib):
+    """The point of the native core: parsing is much faster than Python.
+    Soft bound (3x) so CI noise can't flake it; typical is >30x."""
+    import time
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(20000):
+        feats = rng.integers(0, 1 << 20, 30)
+        lines.append("1 " + " ".join(f"{f}:1" for f in feats))
+    text = "\n".join(lines) + "\n"
+
+    t0 = time.perf_counter()
+    a = native.parse_text(text, "libsvm")
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    b = P.parse_libsvm(text)
+    t_py = time.perf_counter() - t0
+    _assert_blocks_equal(a, b)
+    assert t_native < t_py / 3, (t_native, t_py)

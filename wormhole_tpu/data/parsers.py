@@ -133,12 +133,17 @@ _PARSERS = {
 
 def parse_text(text: str, fmt: str) -> RowBlock:
     """Parse a chunk of text in the given format (dispatch parity with
-    reference minibatch_iter.h:42-59)."""
-    try:
-        parser = _PARSERS[fmt]
-    except KeyError:
-        raise ValueError(f"unknown data format: {fmt!r}") from None
-    return parser(text)
+    reference minibatch_iter.h:42-59). Uses the native C++ core when its
+    shared library is available (wormhole_tpu/native), with these Python
+    parsers as the reference implementation and fallback."""
+    if fmt not in _PARSERS:
+        raise ValueError(f"unknown data format: {fmt!r}")
+    from wormhole_tpu import native
+
+    blk = native.parse_text(text, fmt)
+    if blk is not None:
+        return blk
+    return _PARSERS[fmt](text)
 
 
 def iter_file_chunks(

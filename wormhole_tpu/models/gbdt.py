@@ -341,29 +341,53 @@ class GbdtLearner:
         return trees, node
 
     def _round_fns(self):
-        fns = self._jit_cache.get("round")
+        key = ("round", self._hyper_key())
+        fns = self._jit_cache.get(key)
         if fns is None:
             gh = jax.jit(lambda m, y, msk: self._grad_hess(m, y, msk))
             upd = jax.jit(lambda m, lv, node: m + lv[node])
-            fns = self._jit_cache["round"] = (gh, upd)
+            fns = self._jit_cache[key] = (gh, upd)
         return fns
+
+    def _base_margins(self, ds: BinnedDataset):
+        m = jnp.full(ds.label.shape, self._base_margin(), jnp.float32)
+        return jax.device_put(m, batch_sharding(self.mesh, 1))
 
     def fit(self, verbose: bool = True) -> dict:
         """The boosting loop; prints `[round] name-metric:value` rows like
-        the reference xgboost CLI."""
+        the reference xgboost CLI. With model_in, continues boosting on
+        top of the loaded trees (cfg.num_round more rounds), replaying
+        the prior trees into the margins first."""
         cfg = self.cfg
-        train = self.load_dataset(cfg.train_data, fit_bins=True)
+        extra = cfg.num_round
+        r0 = 0
+        if cfg.model_in:
+            self.load(cfg.model_in)  # sets edges/dim/max_depth/objective
+            r0 = cfg.num_round
+            cfg.num_round = r0 + extra
+        train = self.load_dataset(cfg.train_data, fit_bins=(r0 == 0))
         evals = []
         if cfg.eval_data:
             evals.append((cfg.eval_name, self.load_dataset(cfg.eval_data)))
         if cfg.eval_train:
             evals.append(("train", train))
+        prior = self.trees
+        self.trees = _empty_trees(cfg)
+        for k in self.trees:
+            self.trees[k][:r0] = prior[k][:r0]
         gh, upd = self._round_fns()
-        margin = jnp.full(train.label.shape, self._base_margin(), jnp.float32)
-        margin = jax.device_put(margin, batch_sharding(self.mesh, 1))
-        margins = {name: None for name, _ in evals}
+        margin = self._base_margins(train)
+        margins = {name: self._base_margins(ds)
+                   for name, ds in evals if ds is not train}
+        for r in range(r0):  # replay loaded trees (warm start)
+            tree = {k: jnp.asarray(v[r]) for k, v in self.trees.items()}
+            margin = upd(margin, tree["leaf_value"], self._route(train, tree))
+            for name, ds in evals:
+                if ds is not train:
+                    margins[name] = upd(margins[name], tree["leaf_value"],
+                                        self._route(ds, tree))
         last = {}
-        for r in range(cfg.num_round):
+        for r in range(r0, cfg.num_round):
             g, hss = gh(margin, train.label, train.mask)
             tree, node = self._build_tree(train, g, hss)
             for k in self.trees:
@@ -374,11 +398,9 @@ class GbdtLearner:
                 if ds is train:
                     em = margin
                 else:
-                    prev = margins[name]
-                    em = self._apply_tree(ds, tree) if prev is None else \
-                        upd(prev, tree["leaf_value"],
-                            self._route(ds, tree))
-                    margins[name] = em
+                    em = margins[name] = upd(
+                        margins[name], tree["leaf_value"],
+                        self._route(ds, tree))
                 last[name] = m = self._metrics(em, ds)
                 msgs += [f"{name}-{k}:{v:.6f}" for k, v in m.items()]
             if verbose:
@@ -412,11 +434,6 @@ class GbdtLearner:
             fn = self._jit_cache[key] = route
         return fn(ds.binned, tree["split_feat"], tree["split_bin"],
                   tree["is_split"])
-
-    def _apply_tree(self, ds: BinnedDataset, tree):
-        base = jnp.full(ds.label.shape, self._base_margin(), jnp.float32)
-        node = self._route(ds, tree)
-        return base + tree["leaf_value"][node]
 
     def _metrics(self, margin, ds: BinnedDataset) -> dict:
         from wormhole_tpu.ops import metrics as M

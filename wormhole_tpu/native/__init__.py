@@ -1,0 +1,146 @@
+"""ctypes bindings for the native parsing core.
+
+The reference's data path is native C++ (learn/base/*_parser.h over
+dmlc-core's parser machinery); this package is its equivalent: a small
+C++ shared library (`src/parsers.cc`) built with plain g++ and bound via
+ctypes (no pybind11 in the image). The Python parsers in
+wormhole_tpu/data/parsers.py stay the reference implementation and the
+fallback — `tests/test_native.py` cross-checks the two bit-for-bit.
+
+The library is built lazily on first use (`make -C wormhole_tpu/native`);
+set WORMHOLE_NO_NATIVE=1 to force the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libwormhole_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile to a per-process temp name, then os.replace into place, so
+    concurrent first-use builds (multi-process launches on a shared
+    filesystem) can never dlopen a half-written .so."""
+    tmp = f"libwormhole_native.{os.getpid()}.tmp.so"
+    try:
+        r = subprocess.run(
+            ["make", "-C", _DIR, "-s", f"OUT={tmp}"],
+            capture_output=True, timeout=120)
+        if r.returncode != 0:
+            return False
+        os.replace(os.path.join(_DIR, tmp), _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        try:
+            os.remove(os.path.join(_DIR, tmp))
+        except OSError:
+            pass
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.wh_parse.restype = ctypes.c_void_p
+    lib.wh_parse.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                             ctypes.c_int64]
+    lib.wh_rb_size.restype = ctypes.c_int64
+    lib.wh_rb_size.argtypes = [ctypes.c_void_p]
+    lib.wh_rb_nnz.restype = ctypes.c_int64
+    lib.wh_rb_nnz.argtypes = [ctypes.c_void_p]
+    lib.wh_rb_has_value.restype = ctypes.c_int
+    lib.wh_rb_has_value.argtypes = [ctypes.c_void_p]
+    lib.wh_rb_error.restype = ctypes.c_int64
+    lib.wh_rb_error.argtypes = [ctypes.c_void_p]
+    lib.wh_rb_copy.restype = None
+    lib.wh_rb_copy.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 4
+    lib.wh_rb_free.restype = None
+    lib.wh_rb_free.argtypes = [ctypes.c_void_p]
+    lib.wh_cityhash64.restype = ctypes.c_uint64
+    lib.wh_cityhash64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if os.environ.get("WORMHOLE_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+_FORMATS = {"libsvm", "criteo", "criteo_test", "adfea"}
+
+
+def parse_text(text: str, fmt: str):
+    """Native parse of a text chunk -> RowBlock; None when the native path
+    can't serve this request (lib missing or unknown format)."""
+    lib = get_lib()
+    if lib is None or fmt not in _FORMATS:
+        return None
+    from wormhole_tpu.data.rowblock import RowBlock
+
+    data = text.encode("utf-8")
+    h = lib.wh_parse(fmt.encode(), data, len(data))
+    if not h:
+        return None
+    try:
+        err = lib.wh_rb_error(h)
+        if err >= 0:
+            raise ValueError(
+                f"malformed {fmt} input at row {err} (native parser)")
+        n = lib.wh_rb_size(h)
+        nnz = lib.wh_rb_nnz(h)
+        has_val = bool(lib.wh_rb_has_value(h))
+        label = np.empty(n, np.float32)
+        offset = np.empty(n + 1, np.int64)
+        index = np.empty(nnz, np.uint64)
+        value = np.empty(nnz, np.float32) if has_val else None
+        lib.wh_rb_copy(
+            h,
+            label.ctypes.data_as(ctypes.c_void_p),
+            offset.ctypes.data_as(ctypes.c_void_p),
+            index.ctypes.data_as(ctypes.c_void_p),
+            value.ctypes.data_as(ctypes.c_void_p) if has_val else None,
+        )
+        return RowBlock(label=label, offset=offset, index=index, value=value)
+    finally:
+        lib.wh_rb_free(h)
+
+
+def cityhash64(data) -> int:
+    """Native CityHash64; falls back to the Python implementation."""
+    lib = get_lib()
+    s = data.encode() if isinstance(data, str) else bytes(data)
+    if lib is None:
+        from wormhole_tpu.ops.hashing import cityhash64 as py
+
+        return py(s)
+    return int(lib.wh_cityhash64(s, len(s)))

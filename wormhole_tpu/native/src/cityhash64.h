@@ -1,0 +1,160 @@
+// CityHash64 (v1.1) — the hash the reference uses for criteo feature keys
+// (reference learn/base/criteo_parser.h:69-82, built from the cityhash dep,
+// reference make/deps.mk:73-83). Implemented from the public algorithm;
+// cross-checked bit-for-bit against the pure-Python implementation in
+// wormhole_tpu/ops/hashing.py by tests/test_native.py.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace wormhole {
+
+namespace detail {
+
+inline uint64_t Fetch64(const char* p) {
+  uint64_t r;
+  std::memcpy(&r, p, 8);
+  return r;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+inline uint32_t Fetch32(const char* p) {
+  uint32_t r;
+  std::memcpy(&r, p, 4);
+  return r;
+}
+
+inline uint64_t Rotate(uint64_t v, int s) {
+  return s == 0 ? v : (v >> s) | (v << (64 - s));
+}
+
+inline uint64_t ShiftMix(uint64_t v) { return v ^ (v >> 47); }
+
+inline uint64_t Bswap64(uint64_t v) { return __builtin_bswap64(v); }
+
+constexpr uint64_t k0 = 0xc3a5c85c97cb3127ULL;
+constexpr uint64_t k1 = 0xb492b66fbe98f273ULL;
+constexpr uint64_t k2 = 0x9ae16a3b2f90404fULL;
+constexpr uint64_t kMul = 0x9ddfea08eb382d69ULL;
+
+inline uint64_t HashLen16(uint64_t u, uint64_t v, uint64_t mul) {
+  uint64_t a = (u ^ v) * mul;
+  a ^= a >> 47;
+  uint64_t b = (v ^ a) * mul;
+  b ^= b >> 47;
+  return b * mul;
+}
+
+inline uint64_t Hash128to64(uint64_t u, uint64_t v) {
+  return HashLen16(u, v, kMul);
+}
+
+inline uint64_t HashLen0to16(const char* s, size_t n) {
+  if (n >= 8) {
+    uint64_t mul = k2 + n * 2;
+    uint64_t a = Fetch64(s) + k2;
+    uint64_t b = Fetch64(s + n - 8);
+    uint64_t c = Rotate(b, 37) * mul + a;
+    uint64_t d = (Rotate(a, 25) + b) * mul;
+    return HashLen16(c, d, mul);
+  }
+  if (n >= 4) {
+    uint64_t mul = k2 + n * 2;
+    uint64_t a = Fetch32(s);
+    return HashLen16(n + (a << 3), Fetch32(s + n - 4), mul);
+  }
+  if (n > 0) {
+    uint8_t a = s[0], b = s[n >> 1], c = s[n - 1];
+    uint32_t y = static_cast<uint32_t>(a) + (static_cast<uint32_t>(b) << 8);
+    uint32_t z = static_cast<uint32_t>(n) + (static_cast<uint32_t>(c) << 2);
+    return ShiftMix(y * k2 ^ z * k0) * k2;
+  }
+  return k2;
+}
+
+inline uint64_t HashLen17to32(const char* s, size_t n) {
+  uint64_t mul = k2 + n * 2;
+  uint64_t a = Fetch64(s) * k1;
+  uint64_t b = Fetch64(s + 8);
+  uint64_t c = Fetch64(s + n - 8) * mul;
+  uint64_t d = Fetch64(s + n - 16) * k2;
+  return HashLen16(Rotate(a + b, 43) + Rotate(c, 30) + d,
+                   a + Rotate(b + k2, 18) + c, mul);
+}
+
+inline uint64_t HashLen33to64(const char* s, size_t n) {
+  uint64_t mul = k2 + n * 2;
+  uint64_t a = Fetch64(s) * k2;
+  uint64_t b = Fetch64(s + 8);
+  uint64_t c = Fetch64(s + n - 24);
+  uint64_t d = Fetch64(s + n - 32);
+  uint64_t e = Fetch64(s + 16) * k2;
+  uint64_t f = Fetch64(s + 24) * 9;
+  uint64_t g = Fetch64(s + n - 8);
+  uint64_t h = Fetch64(s + n - 16) * mul;
+  uint64_t u = Rotate(a + g, 43) + (Rotate(b, 30) + c) * 9;
+  uint64_t v = ((a + g) ^ d) + f + 1;
+  uint64_t w = Bswap64((u + v) * mul) + h;
+  uint64_t x = Rotate(e + f, 42) + c;
+  uint64_t y = (Bswap64((v + w) * mul) + g) * mul;
+  uint64_t z = e + f + c;
+  a = Bswap64((x + z) * mul + y) + b;
+  b = ShiftMix((z + a) * mul + d + h) * mul;
+  return b + x;
+}
+
+struct U64Pair {
+  uint64_t first, second;
+};
+
+inline U64Pair WeakHashLen32WithSeeds(uint64_t w, uint64_t x, uint64_t y,
+                                      uint64_t z, uint64_t a, uint64_t b) {
+  a += w;
+  b = Rotate(b + a + z, 21);
+  uint64_t c = a;
+  a += x;
+  a += y;
+  b += Rotate(a, 44);
+  return {a + z, b + c};
+}
+
+inline U64Pair WeakHashLen32WithSeeds(const char* s, uint64_t a, uint64_t b) {
+  return WeakHashLen32WithSeeds(Fetch64(s), Fetch64(s + 8), Fetch64(s + 16),
+                                Fetch64(s + 24), a, b);
+}
+
+}  // namespace detail
+
+inline uint64_t CityHash64(const char* s, size_t n) {
+  using namespace detail;
+  if (n <= 16) return HashLen0to16(s, n);
+  if (n <= 32) return HashLen17to32(s, n);
+  if (n <= 64) return HashLen33to64(s, n);
+  uint64_t x = Fetch64(s + n - 40);
+  uint64_t y = Fetch64(s + n - 16) + Fetch64(s + n - 56);
+  uint64_t z = Hash128to64(Fetch64(s + n - 48) + n, Fetch64(s + n - 24));
+  U64Pair v = WeakHashLen32WithSeeds(s + n - 64, n, z);
+  U64Pair w = WeakHashLen32WithSeeds(s + n - 32, y + k1, x);
+  x = x * k1 + Fetch64(s);
+  size_t pos = 0;
+  size_t rem = (n - 1) & ~static_cast<size_t>(63);
+  do {
+    x = Rotate(x + y + v.first + Fetch64(s + pos + 8), 37) * k1;
+    y = Rotate(y + v.second + Fetch64(s + pos + 48), 42) * k1;
+    x ^= w.second;
+    y += v.first + Fetch64(s + pos + 40);
+    z = Rotate(z + w.first, 33) * k1;
+    v = WeakHashLen32WithSeeds(s + pos, v.second * k1, x + w.first);
+    w = WeakHashLen32WithSeeds(s + pos + 32, z + w.second,
+                               y + Fetch64(s + pos + 16));
+    uint64_t t = z;
+    z = x;
+    x = t;
+    pos += 64;
+    rem -= 64;
+  } while (rem != 0);
+  return Hash128to64(Hash128to64(v.first, w.first) + ShiftMix(y) * k1 + z,
+                     Hash128to64(v.second, w.second) + x);
+}
+
+}  // namespace wormhole
