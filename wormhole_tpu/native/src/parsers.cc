@@ -69,6 +69,13 @@ void parse_libsvm(const char* buf, size_t len, RowBlockBuf* out) {
       q = next;
       float v = 1.0f;
       if (q < eol && *q == ':') {
+        // the value must start right after ':' — strtof skips leading
+        // whitespace, which would silently consume the NEXT token where
+        // python float('') raises on the empty value
+        if (q + 1 >= eol || is_space(q[1])) {
+          out->error_row = static_cast<int64_t>(out->label.size()) - 1;
+          return;
+        }
         v = strtof(q + 1, &next);
         // empty/garbage value, or strtof skipped past the newline into
         // the next line (python float('') would raise)
@@ -114,9 +121,23 @@ void parse_criteo(const char* buf, size_t len, bool has_label,
     if (has_label) {
       const char* tab =
           static_cast<const char*>(memchr(q, '\t', line_end - q));
+      const char* tok_end = tab ? tab : line_end;
+      // the label token must contain a non-space char inside [q, tok_end):
+      // strtof skips whitespace (including the '\t' separator), so an
+      // empty label field would silently read the first feature as the
+      // label where python float('') raises
+      const char* s = q;
+      while (s < tok_end && is_space(*s)) ++s;
       char* next = nullptr;
-      float lab = strtof(q, &next);
-      if (next == q) {  // python float() would raise
+      float lab = (s < tok_end) ? strtof(s, &next) : 0.0f;
+      if (s >= tok_end || next == s) {  // python float() would raise
+        out->error_row = static_cast<int64_t>(out->label.size());
+        return;
+      }
+      // full-token consumption (python float('1abc') raises); trailing
+      // whitespace is fine — python float() strips it
+      while (next < tok_end && is_space(*next)) ++next;
+      if (next != tok_end) {
         out->error_row = static_cast<int64_t>(out->label.size());
         return;
       }
@@ -168,7 +189,8 @@ void parse_adfea(const char* buf, size_t len, RowBlockBuf* out) {
         char* next = nullptr;
         std::string ls(tok, q - tok);
         label = strtof(ls.c_str(), &next);
-        if (next == ls.c_str()) {  // python float() would raise
+        // full consumption: python float('1x') raises
+        if (next == ls.c_str() || *next != '\0') {
           out->error_row = static_cast<int64_t>(out->label.size());
           return;
         }
@@ -177,11 +199,14 @@ void parse_adfea(const char* buf, size_t len, RowBlockBuf* out) {
         const char* colon =
             static_cast<const char*>(memchr(tok, ':', q - tok));
         char* next = nullptr;
+        // tokens are whitespace-split, so python int() accepts exactly a
+        // full run of digits — require strtoull to consume to the
+        // delimiter (int('12x') raises)
         if (colon) {
           uint64_t fid = strtoull(tok, &next, 10);
-          bool bad = (next == tok);
+          bool bad = (next != colon);
           uint64_t gid = strtoull(colon + 1, &next, 10);
-          bad |= (next == colon + 1);
+          bad |= (next != q);
           if (bad) {  // python int() would raise
             out->error_row = static_cast<int64_t>(out->label.size());
             return;
@@ -189,7 +214,7 @@ void parse_adfea(const char* buf, size_t len, RowBlockBuf* out) {
           out->index.push_back((fid >> 10) | ((gid & 0x3FF) << 54));
         } else {
           uint64_t fid = strtoull(tok, &next, 10);
-          if (next == tok) {
+          if (next != q) {
             out->error_row = static_cast<int64_t>(out->label.size());
             return;
           }

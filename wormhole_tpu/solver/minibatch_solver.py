@@ -126,8 +126,12 @@ class MinibatchSolver:
                                       if wtype == WorkType.TRAIN else 1.0),
                         seed=data_pass * 7919 + part_id,
                     )
+                    prepare = getattr(self.learner, "prepare_batch", None)
                     for blk in it:
-                        if not _put(blk):
+                        # host-side batch prep (padding + pallas tile-sort)
+                        # happens here in the loader thread, overlapped with
+                        # the main thread's device steps
+                        if not _put(prepare(blk) if prepare else blk):
                             return
                     pool.finish(part_id)
             except BaseException as e:
