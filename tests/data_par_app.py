@@ -1,0 +1,63 @@
+"""Fake-workload distributed app for launcher tests — the reference's
+data_parallel_test.cc: workers sleep a random time per part instead of
+computing; the scheduler dispatches empty file parts and prints progress.
+Run under the launcher:
+
+  python -m wormhole_tpu.launcher.dmlc_tpu -n 4 -s 2 -- \
+      python tests/data_par_app.py <data_dir> [crash_rank]
+
+A `crash_rank` worker exits abruptly after taking its first part, to
+exercise the node-failure re-queue path (data_parallel.h:131-135).
+"""
+
+import random
+import sys
+import time
+
+from wormhole_tpu.runtime.tracker import (
+    RemotePool, Scheduler, SchedulerClient, node_env,
+)
+from wormhole_tpu.solver.workload import WorkType
+
+
+def main():
+    data = sys.argv[1]
+    crash_rank = int(sys.argv[2]) if len(sys.argv) > 2 else -1
+    env = node_env()
+    if env.role.value == "scheduler":
+        sched = Scheduler.from_env(env)
+        sched.node_timeout = 3.0
+        sched.serve()
+        n = sched.start_round(f"{data}/part-.*", 2, "libsvm",
+                              WorkType.TRAIN, 0)
+        print(f"dispatching {n} files", flush=True)
+        sched.wait_round(print_sec=0.5, verbose=False)
+        print(f"finished; progress n={sched.progress.value('n')}",
+              flush=True)
+        sched.announce_shutdown()
+        time.sleep(1.0)
+        sched.stop()
+        return 0
+
+    client = SchedulerClient(env.scheduler_uri, f"worker-{env.rank}")
+    client.register()
+    pool = RemotePool(client, poll=0.05)
+    taken = 0
+    while pool.sync_round() is not None:
+        while (got := pool.get()) is not None:
+            part_id, f = got
+            taken += 1
+            if env.rank == crash_rank:
+                print("crashing deliberately", flush=True)
+                import os
+
+                os._exit(17)
+            t = random.random() * 0.2
+            time.sleep(t)
+            print(f"worker {env.rank}: {f} time={t:.2f}", flush=True)
+            pool.finish(part_id, {"n": 1})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""CLI apps — the reference's `bin/*.dmlc` binaries (README.md:43) as
+python -m entry points:
+
+  python -m wormhole_tpu.apps.linear   conf [key=val ...]   linear.dmlc
+  python -m wormhole_tpu.apps.difacto  conf [key=val ...]   difacto.dmlc
+  python -m wormhole_tpu.apps.kmeans   [key=val ...]        kmeans.dmlc
+  python -m wormhole_tpu.apps.lbfgs_linear [key=val ...]    linear.dmlc (L-BFGS)
+  python -m wormhole_tpu.apps.lbfgs_fm     [key=val ...]    fm.dmlc
+  python -m wormhole_tpu.apps.gbdt     conf [key=val ...]   xgboost.dmlc
+  python -m wormhole_tpu.apps.convert  [key=val ...]        tool/convert
+
+Each reads a `key = value` conf file plus CLI overrides (arg_parser.h
+semantics) and dispatches on the launcher-set role env (linear.cc:13-20);
+without a role they run single-process on the local device mesh.
+"""

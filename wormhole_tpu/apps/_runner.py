@@ -1,0 +1,144 @@
+"""Shared app runner: conf parsing + role dispatch + distributed loops.
+
+The reference's minibatch apps are a scheduler/server/worker triple over
+ps-lite (reference linear.cc:6-25 role dispatch; minibatch_solver.h:85-195
+scheduler loop; :284-329 worker loop). Here:
+
+- no role env (the common case): single process drives the full solver on
+  the local device mesh — scheduler, "servers" (sharded tables in HBM)
+  and worker in one.
+- scheduler role: owns the control plane — per-pass workload rounds,
+  merged progress rows, early stop, shutdown announcement.
+- worker role: a MinibatchSolver whose pool is the scheduler's RemotePool;
+  model state is device-resident per worker process. On a pod slice each
+  worker is one host of the global mesh (jax.distributed); in the
+  single-machine integration harness each worker holds a replica and
+  trains its share of parts — the async-PS throughput model, with
+  worker 0 saving the model (the reference's per-rank part naming).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from wormhole_tpu.config import load_config
+from wormhole_tpu.runtime.tracker import (
+    RemotePool, Scheduler, SchedulerClient, node_env,
+)
+from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+from wormhole_tpu.solver.progress import Progress
+from wormhole_tpu.solver.workload import WorkType
+from wormhole_tpu.utils import checkpoint as ckpt
+
+
+def parse_cli(cls, argv):
+    """conf file (optional first arg without '=') + key=value overrides —
+    the reference's `app.dmlc conf k=v` convention (arg_parser.h:36-45)."""
+    conf = None
+    rest = list(argv)
+    if rest and "=" not in rest[0]:
+        conf = rest.pop(0)
+    return load_config(cls, conf_file=conf, argv=rest)
+
+
+def run_minibatch_app(cfg, make_learner, verbose: bool = True) -> dict:
+    """Entry for linear/difacto-style streaming apps."""
+    env = node_env()
+    if env.role is None:
+        learner = make_learner(cfg, env)
+        return MinibatchSolver(learner, cfg, verbose=verbose).run()
+    if env.role.value == "scheduler":
+        return _run_scheduler(cfg, env, verbose)
+    return _run_worker(cfg, env, make_learner, verbose)
+
+
+def _run_scheduler(cfg, env, verbose: bool) -> dict:
+    sched = Scheduler.from_env(env)
+    sched.serve()
+    t0 = time.time()
+    result = {}
+    try:
+        for dp in range(cfg.max_data_pass):
+            n = sched.start_round(cfg.train_data, cfg.num_parts_per_file,
+                                  cfg.data_format, WorkType.TRAIN, dp)
+            if verbose:
+                print(f"training pass {dp}: {n} files", flush=True)
+            result["train"] = sched.wait_round(cfg.print_sec, t0, verbose)
+            if cfg.val_data:
+                sched.start_round(cfg.val_data, cfg.num_parts_per_file,
+                                  cfg.data_format, WorkType.VAL, dp)
+                if verbose:
+                    print(f"validation pass {dp}", flush=True)
+                result["val"] = sched.wait_round(cfg.print_sec, t0, verbose)
+        sched.announce_shutdown()
+        # let workers observe shutdown + save before the server dies
+        time.sleep(1.0)
+        return result
+    finally:
+        sched.stop()
+
+
+def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
+    learner = make_learner(cfg, env)
+    client = SchedulerClient(env.scheduler_uri, f"worker-{env.rank}")
+    client.register()
+    pool = RemotePool(client)
+    if cfg.model_in:
+        ckpt.load_model(_store(learner), cfg.model_in,
+                        cfg.load_iter if cfg.load_iter >= 0 else None)
+    solver = MinibatchSolver(learner, cfg, verbose=False)
+    result = {}
+    while (rnd := pool.sync_round()) is not None:
+        wtype = WorkType(rnd["type"])
+        prog = _drain_round(solver, learner, pool, wtype, rnd["data_pass"])
+        result["train" if wtype == WorkType.TRAIN else "val"] = prog
+    if cfg.model_out:
+        # per-rank part naming, iter_solver.h:115-119
+        ckpt.save_model(_store(learner), f"{cfg.model_out}_part-{env.rank}")
+    if getattr(cfg, "predict_out", None):
+        solver.predict(cfg.val_data or cfg.train_data,
+                       f"{cfg.predict_out}_rank-{env.rank}")
+    return result
+
+
+def _store(learner):
+    return getattr(learner, "ckpt_store", None) or learner.store
+
+
+def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass):
+    """Worker side of one dispatch round: pull parts until the round is
+    globally done, stream minibatches through the learner, report summed
+    progress per part (the finish RPC carries it, replacing the timed
+    ps::Slave channel)."""
+    from wormhole_tpu.data.minibatch import MinibatchIter
+
+    cfg = solver.cfg
+    prog = Progress()
+    step = (learner.train_batch if wtype == WorkType.TRAIN
+            else learner.eval_batch)
+    while (got := pool.get()) is not None:
+        part_id, f = got
+        part_prog: dict = {}
+        for blk in MinibatchIter(
+            f.filename, f.part, f.num_parts, f.format,
+            minibatch_size=cfg.minibatch,
+            shuf_buf=(cfg.rand_shuffle * cfg.minibatch
+                      if wtype == WorkType.TRAIN else 0),
+            neg_sampling=(cfg.neg_sampling
+                          if wtype == WorkType.TRAIN else 1.0),
+            seed=data_pass * 7919 + part_id,
+        ):
+            p = step(blk)
+            for k, v in p.items():
+                part_prog[k] = part_prog.get(k, 0.0) + float(v)
+        prog.merge(part_prog)
+        pool.finish(part_id, part_prog)
+    return prog
+
+
+def app_main(cls, make_learner, argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cfg = parse_cli(cls, argv)
+    run_minibatch_app(cfg, make_learner)
+    return 0

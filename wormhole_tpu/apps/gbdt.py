@@ -1,0 +1,44 @@
+"""xgboost.dmlc: distributed histogram GBDT (reference builds the xgboost
+CLI over rabit, Makefile:63-72; conf surface of mushroom.hadoop.conf).
+
+  python -m wormhole_tpu.apps.gbdt mushroom.conf num_round=10
+"""
+
+from __future__ import annotations
+
+import sys
+
+from wormhole_tpu.apps._runner import parse_cli
+from wormhole_tpu.models.gbdt import GbdtConfig, GbdtLearner
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cfg = parse_cli(GbdtConfig, argv)
+    lrn = GbdtLearner(cfg)
+    if cfg.task == "pred":
+        # xgboost CLI task=pred: load model, write one probability/value
+        # per test row to name_pred
+        assert cfg.model_in, "task=pred needs model_in"
+        lrn.load(cfg.model_in)
+        from wormhole_tpu.solver.workload import iter_rowblocks
+
+        n = 0
+        with open(cfg.pred_out, "w") as f:
+            for blk in iter_rowblocks(cfg.test_data or cfg.train_data,
+                                      cfg.num_parts_per_file,
+                                      cfg.data_format, cfg.minibatch):
+                for p in lrn.predict_blk(blk):
+                    f.write(f"{p:.6g}\n")
+                    n += 1
+        print(f"wrote {n} predictions to {cfg.pred_out}")
+        return 0
+    lrn.fit()
+    if cfg.model_out:
+        lrn.save(cfg.model_out)
+        print(f"saved model to {cfg.model_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""kmeans.dmlc: spherical k-means by BSP allreduce (reference
+learn/kmeans/kmeans.cc). Rabit-style key=value args:
+
+  python -m wormhole_tpu.apps.kmeans data=... num_clusters=16 max_iter=10 \
+      model_out=centroids.txt
+"""
+
+from __future__ import annotations
+
+import sys
+
+from wormhole_tpu.apps._runner import parse_cli
+from wormhole_tpu.models.kmeans import KmeansConfig, KmeansLearner
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    # the reference kmeans takes data= (kmeans.cc SetParam); accept both
+    argv = [a.replace("data=", "train_data=", 1)
+            if a.startswith("data=") else a for a in argv]
+    cfg = parse_cli(KmeansConfig, argv)
+    lrn = KmeansLearner(cfg)
+    objv = lrn.run()
+    print(f"final cosine objective: {objv:.6f}", flush=True)
+    if cfg.model_out:
+        lrn.save(cfg.model_out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""fm.dmlc: batch factorization machine trained by L-BFGS (reference
+learn/lbfgs-fm/fm.cc). Rabit-style key=value args:
+
+  python -m wormhole_tpu.apps.lbfgs_fm data=train.libsvm nfactor=8 \
+      reg_L2=0.1 max_lbfgs_iter=30 model_out=fm.npz
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Optional
+
+import numpy as np
+
+from wormhole_tpu.apps._runner import parse_cli
+from wormhole_tpu.models.batch_objectives import FmObjFunction, load_batches
+from wormhole_tpu.parallel.mesh import make_mesh
+from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
+
+
+@dataclasses.dataclass
+class LbfgsFmConfig:
+    """Key surface of the reference fm.cc SetParam loop: nfactor (the
+    embedding dim k), init_sigma (fm.cc:141-156), regularizers, iters."""
+
+    data: str = ""
+    data_format: str = "libsvm"
+    model_out: Optional[str] = None
+    nfactor: int = 8
+    init_sigma: float = 0.01
+    reg_L1: float = 0.0
+    reg_L2: float = 0.0
+    max_lbfgs_iter: int = 30
+    lbfgs_stop_tol: float = 1e-7
+    m: int = 10
+    minibatch: int = 4096
+    nnz_per_row: int = 64
+    num_parts_per_file: int = 1
+    seed: int = 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cfg = parse_cli(LbfgsFmConfig, argv)
+    mesh = make_mesh()
+    batches, num_feature = load_batches(
+        cfg.data, mesh, cfg.data_format, cfg.minibatch, cfg.nnz_per_row,
+        cfg.num_parts_per_file)
+    obj = FmObjFunction(batches, num_feature, cfg.nfactor, mesh,
+                        init_sigma=cfg.init_sigma, seed=cfg.seed)
+    solver = LBFGSSolver(obj, LBFGSConfig(
+        max_iter=cfg.max_lbfgs_iter, m=cfg.m, reg_l1=cfg.reg_L1,
+        reg_l2=cfg.reg_L2, min_rel_decrease=cfg.lbfgs_stop_tol))
+    w, objv = solver.run()
+    print(f"final objective: {objv:.6f}")
+    if cfg.model_out:
+        np.savez(cfg.model_out, w=np.asarray(w), nfactor=cfg.nfactor,
+                 num_feature=num_feature)
+        print(f"saved model to {cfg.model_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""lbfgs linear.dmlc: batch logistic/linear regression trained by
+distributed L-BFGS/OWL-QN (reference learn/lbfgs-linear/lbfgs.cc).
+Rabit-style key=value args:
+
+  python -m wormhole_tpu.apps.lbfgs_linear data=train.libsvm \
+      reg_L1=1 max_lbfgs_iter=30 model_out=model.npz \
+      task=train|pred [test_data=... pred_out=...]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Optional
+
+import numpy as np
+
+from wormhole_tpu.apps._runner import parse_cli
+from wormhole_tpu.models.batch_objectives import (
+    LinearObjFunction, load_batches,
+)
+from wormhole_tpu.parallel.mesh import make_mesh
+from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
+
+
+@dataclasses.dataclass
+class LbfgsLinearConfig:
+    """Key surface of the reference lbfgs.cc SetParam loop (:236-241):
+    reg_L1, max_lbfgs_iter, lbfgs_stop_tol, model_in/out, task."""
+
+    data: str = ""
+    test_data: Optional[str] = None
+    data_format: str = "libsvm"
+    task: str = "train"         # train | pred  (lbfgs.cc:55-69)
+    model_in: Optional[str] = None
+    model_out: Optional[str] = None
+    pred_out: str = "pred.txt"
+    reg_L1: float = 0.0
+    reg_L2: float = 0.0
+    max_lbfgs_iter: int = 30
+    lbfgs_stop_tol: float = 1e-7
+    m: int = 10
+    minibatch: int = 4096
+    nnz_per_row: int = 64
+    num_parts_per_file: int = 1
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cfg = parse_cli(LbfgsLinearConfig, argv)
+    mesh = make_mesh()
+    if cfg.task == "pred":
+        # the reference's TaskPred: load binf model, write one margin per
+        # example (lbfgs.cc:70-85)
+        assert cfg.model_in, "pred task needs model_in"
+        if not cfg.model_in.endswith(".npz"):
+            cfg.model_in += ".npz"
+        w = np.load(cfg.model_in)["w"]
+        batches, _ = load_batches(
+            cfg.test_data or cfg.data, mesh, cfg.data_format,
+            cfg.minibatch, cfg.nnz_per_row, cfg.num_parts_per_file)
+        obj = LinearObjFunction(batches, len(w) - 1, mesh)
+        wp = obj.place(np.asarray(w, np.float32))
+        n = 0
+        with open(cfg.pred_out, "w") as f:
+            for seg, idx, val, label, mask in batches:
+                margins = np.asarray(
+                    obj.predict(wp, seg, idx, val, cfg.minibatch))
+                keep = np.asarray(mask) > 0
+                for m in margins[keep]:
+                    f.write(f"{m:.6g}\n")
+                n += int(keep.sum())
+        print(f"wrote {n} predictions to {cfg.pred_out}")
+        return 0
+
+    batches, num_feature = load_batches(
+        cfg.data, mesh, cfg.data_format, cfg.minibatch, cfg.nnz_per_row,
+        cfg.num_parts_per_file)
+    obj = LinearObjFunction(batches, num_feature, mesh)
+    solver = LBFGSSolver(obj, LBFGSConfig(
+        max_iter=cfg.max_lbfgs_iter, m=cfg.m, reg_l1=cfg.reg_L1,
+        reg_l2=cfg.reg_L2, min_rel_decrease=cfg.lbfgs_stop_tol))
+    w, objv = solver.run()
+    print(f"final objective: {objv:.6f}")
+    if cfg.model_out:
+        np.savez(cfg.model_out, w=np.asarray(w))
+        print(f"saved model to {cfg.model_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

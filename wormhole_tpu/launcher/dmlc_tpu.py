@@ -1,0 +1,127 @@
+"""dmlc_tpu: launch a distributed wormhole-tpu job.
+
+Parity with the reference trackers (dmlc-core tracker/dmlc_local.py,
+dmlc_mpi.py, dmlc_yarn.py — reference doc/common/build.rst:53-123): spawn
+1 scheduler + N worker processes of the same program, wiring the role /
+rank / rendezvous env vars the program reads via `runtime.node_env()`.
+
+Mapping the reference's launch dimensions onto TPU:
+- `-n` workers = host processes, one per TPU host in a pod slice (or N
+  local processes for the single-host / CPU-mesh integration tests —
+  exactly how the reference tests multi-node on localhost,
+  data_parallel_test.cc:8).
+- `-s` servers = model-axis shards of the parameter mesh, not separate
+  processes: the "server group" is the sharded HBM tables updated inside
+  the jitted step (SURVEY.md §2.2 ps-lite row). The value is exported as
+  WH_NUM_SERVERS and consumed as the mesh's model-axis size.
+- multi-host pods: each worker also gets a rank so apps can call
+  jax.distributed.initialize and form the global device mesh over
+  ICI/DCN; the control plane here stays the same.
+
+Usage:
+  python -m wormhole_tpu.launcher.dmlc_tpu -n 4 -s 2 -- \
+      python -m wormhole_tpu.apps.linear learn/linear/demo.conf
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(prefix: str, pipe, out):
+    for line in iter(pipe.readline, b""):
+        out.write(f"[{prefix}] ".encode() + line)
+        out.flush()
+
+
+def launch(num_workers: int, num_servers: int, cmd: list[str],
+           node_timeout: float = 30.0,
+           env_extra: dict | None = None) -> int:
+    """Spawn the scheduler + N workers of `cmd`; stream their output with
+    role prefixes; return the first nonzero exit code (0 if all clean).
+    On scheduler exit, surviving workers are terminated (the reference
+    tracker's process-group teardown)."""
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+
+    def spawn(role: str, rank: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(
+            WH_ROLE=role,
+            WH_RANK=str(rank),
+            WH_NUM_WORKERS=str(num_workers),
+            WH_NUM_SERVERS=str(num_servers),
+            WH_SCHEDULER_URI=uri,
+            WH_NODE_TIMEOUT=str(node_timeout),
+        )
+        if env_extra:
+            env.update({k: str(v) for k, v in env_extra.items()})
+        return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
+    sched = spawn("scheduler", 0)
+    workers = [spawn("worker", r) for r in range(num_workers)]
+    procs = {"scheduler": sched}
+    procs.update({f"worker-{r}": p for r, p in enumerate(workers)})
+    threads = []
+    for name, p in procs.items():
+        t = threading.Thread(target=_stream,
+                             args=(name, p.stdout, sys.stdout.buffer),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    try:
+        rc = sched.wait()
+        # give workers a grace period to drain, then terminate leftovers
+        for p in workers:
+            try:
+                rc = max(rc, p.wait(timeout=10))
+            except subprocess.TimeoutExpired:
+                p.send_signal(signal.SIGTERM)
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        return rc
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for t in threads:
+            t.join(timeout=2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dmlc_tpu",
+        description="local multi-process launcher (dmlc_local.py parity)")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=1,
+                    help="model-axis shards (parameter mesh dimension)")
+    ap.add_argument("--node-timeout", type=float, default=30.0)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="program to launch (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given")
+    return launch(args.num_workers, args.num_servers, cmd,
+                  node_timeout=args.node_timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,10 @@ class GbdtConfig:
     data_format: str = "libsvm"
     model_out: Optional[str] = None
     model_in: Optional[str] = None
+    # xgboost CLI task surface: task=pred + test:data + name_pred
+    task: str = "train"
+    test_data: Optional[str] = None
+    pred_out: str = "pred.txt"
 
     booster: str = "gbtree"
     objective: str = "binary:logistic"   # or reg:squarederror

@@ -1,0 +1,3 @@
+from wormhole_tpu.runtime.tracker import (  # noqa: F401
+    Scheduler, SchedulerClient, RemotePool, node_env, Role,
+)

@@ -1,0 +1,335 @@
+"""Distributed control plane: scheduler RPC + remote workload pool.
+
+The reference's control plane is ps-lite Task messages between the
+scheduler and worker/server processes (reference learn/solver/
+data_parallel.h:93-206: StartDispatch / SendWorkload / ProcessResponse,
+node-failure re-queue at :131-135) plus the rabit tracker's rendezvous.
+On TPU the DATA plane is XLA collectives over ICI/DCN (SURVEY.md §5), so
+what remains host-side is exactly this thin control protocol:
+
+- workload dispatch: workers ask for file parts, the scheduler hands out
+  parts from a WorkloadPool (elastic: straggler re-queue, failure reset);
+- progress: workers push mergeable metric vectors, the scheduler sums and
+  prints rows (the ps::Root/Slave monitor channel, iter_solver.h:62-164);
+- barrier: BSP phase sync for the rabit-style apps (kmeans, L-BFGS);
+- liveness: nodes that stop polling past a timeout get their assigned
+  parts re-queued (AddNodeFailureHandler parity).
+
+Transport is newline-delimited JSON over TCP, one connection per request
+— control traffic is per-file-part (seconds), not per-minibatch, so
+simplicity beats throughput here. The launcher (launcher/dmlc_tpu.py)
+spawns the node processes and wires the env vars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from enum import Enum
+from typing import Optional
+
+from wormhole_tpu.solver.progress import Progress
+from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
+
+
+class Role(str, Enum):
+    SCHEDULER = "scheduler"
+    WORKER = "worker"
+    SERVER = "server"
+
+
+@dataclasses.dataclass
+class NodeEnv:
+    """Role/rank/addressing as the launcher exports it (the reference
+    discovers these via ps-lite/rabit env vars, linear.cc:13-20)."""
+
+    role: Optional[Role]
+    rank: int
+    num_workers: int
+    num_servers: int
+    scheduler_uri: str
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.role is not None
+
+
+def node_env() -> NodeEnv:
+    role = os.environ.get("WH_ROLE")
+    return NodeEnv(
+        role=Role(role) if role else None,
+        rank=int(os.environ.get("WH_RANK", "0")),
+        num_workers=int(os.environ.get("WH_NUM_WORKERS", "1")),
+        num_servers=int(os.environ.get("WH_NUM_SERVERS", "1")),
+        scheduler_uri=os.environ.get("WH_SCHEDULER_URI", ""),
+    )
+
+
+# --------------------------------------------------------------- scheduler
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        line = self.rfile.readline()
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+            resp = self.server.scheduler._dispatch(req)  # type: ignore
+        except Exception as e:  # malformed request must not kill the server
+            resp = {"error": repr(e)}
+        self.wfile.write((json.dumps(resp) + "\n").encode())
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class Scheduler:
+    """The scheduler node: owns the WorkloadPool, the summed Progress, and
+    the liveness table. Start with serve(); stop() shuts down."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 node_timeout: float = 30.0, straggler: bool = True):
+        self.pool = WorkloadPool()
+        self.progress = Progress()
+        self.node_timeout = node_timeout
+        self._lock = threading.Lock()
+        self._nodes: dict[str, float] = {}       # node -> last seen
+        self._barriers: dict[str, set] = {}      # name -> arrived nodes
+        self._barrier_gen: dict[str, int] = {}   # name -> generation
+        self._epoch = 0                          # bumped per dispatch round
+        self._shutdown = False                   # job end; workers exit
+        self._done = False
+        self._srv = _Server((host, port), _Handler)
+        self._srv.scheduler = self  # type: ignore
+        self._threads: list[threading.Thread] = []
+        if straggler:
+            self.pool.start_straggler_killer()
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def uri(self) -> str:
+        h, p = self._srv.server_address[:2]
+        return f"{h}:{p}"
+
+    def serve(self) -> None:
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        w = threading.Thread(target=self._liveness_loop, daemon=True)
+        w.start()
+        self._threads.append(w)
+
+    def announce_shutdown(self) -> None:
+        """Mark the job finished; workers see it on their next epoch poll
+        and exit their dispatch loop."""
+        self._shutdown = True
+
+    def stop(self) -> None:
+        self._done = True
+        self.pool.stop_straggler_killer()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    @staticmethod
+    def from_env(env) -> "Scheduler":
+        """Bind the scheduler on the URI the launcher allocated
+        (WH_SCHEDULER_URI)."""
+        host, port = env.scheduler_uri.rsplit(":", 1)
+        return Scheduler(
+            host=host, port=int(port),
+            node_timeout=float(os.environ.get("WH_NODE_TIMEOUT", "30")),
+        )
+
+    # -- dispatch round management -----------------------------------------
+    def start_round(self, pattern: str, num_parts_per_file: int,
+                    fmt: str, wtype: WorkType, data_pass: int) -> int:
+        """Load a pass's file parts into the pool (StartDispatch parity,
+        data_parallel.h:93-115)."""
+        self.pool.clear()
+        self.progress = Progress()
+        with self._lock:
+            self._epoch += 1
+            self._round = dict(type=int(wtype), data_pass=data_pass)
+        return self.pool.add(pattern, num_parts_per_file, fmt)
+
+    def wait_round(self, print_sec: float = 1.0, t0: Optional[float] = None,
+                   verbose: bool = True) -> Progress:
+        """Block until every part is done, printing progress rows
+        (ShowProgress parity, minibatch_solver.h:169-192)."""
+        t0 = t0 or time.time()
+        if verbose:
+            print(Progress.header(), flush=True)
+        while not self.pool.is_finished():
+            time.sleep(print_sec)
+            if verbose:
+                print(self.progress.row(t0), flush=True)
+        if verbose:
+            print(self.progress.row(t0), flush=True)
+        return self.progress
+
+    # -- RPC ops ------------------------------------------------------------
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        node = req.get("node", "?")
+        with self._lock:
+            self._nodes[node] = time.monotonic()
+        if op == "register":
+            return {"ok": True, "epoch": self._epoch}
+        if op == "get":
+            if req.get("epoch") != self._epoch:
+                # worker is in an older round; tell it to resync
+                return {"wait": True, "epoch": self._epoch}
+            got = self.pool.get(node)
+            if got is None:
+                done = self.pool.is_finished()
+                return {"done": done, "wait": not done, "epoch": self._epoch}
+            part_id, f = got
+            return {
+                "part_id": part_id,
+                "file": dataclasses.asdict(f),
+                "round": self._round,
+                "epoch": self._epoch,
+            }
+        if op == "finish":
+            counted = (req.get("epoch") == self._epoch
+                       and self.pool.finish(req["part_id"]))
+            # a straggler twin's duplicate finish is dropped so its
+            # progress is not double-counted (at-least-once execution,
+            # exactly-once accounting)
+            if counted and req.get("progress"):
+                self.progress.merge(req["progress"])
+            return {"ok": True}
+        if op == "report":  # pure progress push (ps::Slave channel)
+            self.progress.merge(req.get("progress", {}))
+            return {"ok": True}
+        if op == "epoch":
+            return {"epoch": self._epoch,
+                    "round": getattr(self, "_round", None),
+                    "shutdown": self._shutdown}
+        if op == "barrier":
+            return self._barrier_enter(req["name"], node, req["world"])
+        if op == "barrier_wait":
+            with self._lock:
+                gen = self._barrier_gen.get(req["name"], 0)
+            return {"released": gen > req["gen"]}
+        return {"error": f"unknown op {op!r}"}
+
+    def _barrier_enter(self, name: str, node: str, world: int) -> dict:
+        """A node arrives at the named barrier. Returns the generation it
+        belongs to; the barrier releases (generation increments) when
+        `world` distinct nodes of that generation have arrived."""
+        with self._lock:
+            gen = self._barrier_gen.setdefault(name, 0)
+            arrived = self._barriers.setdefault(name, set())
+            arrived.add(node)
+            if len(arrived) >= world:
+                self._barrier_gen[name] = gen + 1
+                self._barriers[name] = set()
+                return {"released": True, "gen": gen}
+            return {"released": False, "gen": gen}
+
+    # -- liveness -----------------------------------------------------------
+    def _liveness_loop(self) -> None:
+        while not self._done:
+            time.sleep(min(self.node_timeout / 3, 5.0))
+            now = time.monotonic()
+            with self._lock:
+                dead = [n for n, seen in self._nodes.items()
+                        if now - seen > self.node_timeout]
+                for n in dead:
+                    del self._nodes[n]
+            for n in dead:
+                requeued = self.pool.reset(n)
+                if requeued:
+                    print(f"node {n} lost; re-queued {requeued} parts",
+                          flush=True)
+
+
+# ------------------------------------------------------------------ client
+class SchedulerClient:
+    """Worker-side RPC stub."""
+
+    def __init__(self, uri: str, node: str, timeout: float = 60.0):
+        host, port = uri.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.node = node
+        self.timeout = timeout
+
+    def call(self, **req) -> dict:
+        req.setdefault("node", self.node)
+        with socket.create_connection(self.addr, timeout=self.timeout) as s:
+            f = s.makefile("rw")
+            f.write(json.dumps(req) + "\n")
+            f.flush()
+            resp = json.loads(f.readline())
+        if "error" in resp:
+            raise RuntimeError(f"scheduler error: {resp['error']}")
+        return resp
+
+    def register(self) -> dict:
+        return self.call(op="register")
+
+    def report(self, progress: dict) -> None:
+        self.call(op="report", progress=progress)
+
+    def barrier(self, name: str, world: int, poll: float = 0.1) -> None:
+        """Block until `world` distinct nodes reach the named barrier
+        (rabit tracker rendezvous parity for the BSP apps)."""
+        r = self.call(op="barrier", name=name, world=world)
+        if r["released"]:
+            return
+        gen = r["gen"]
+        while True:
+            time.sleep(poll)
+            if self.call(op="barrier_wait", name=name, gen=gen)["released"]:
+                return
+
+
+class RemotePool:
+    """WorkloadPool-shaped adapter over the scheduler RPC, so the same
+    solver code runs single-process (local pool) or distributed (this).
+    get() returns None only when the whole round is finished; while other
+    workers still hold parts it blocks-and-polls (online mode semantics,
+    data_parallel.h:54-72)."""
+
+    def __init__(self, client: SchedulerClient, poll: float = 0.2):
+        self.client = client
+        self.poll = poll
+        self.epoch = 0  # joins whatever round is live on first sync_round
+        self.round: Optional[dict] = None
+
+    def sync_round(self, wait: bool = True) -> Optional[dict]:
+        """Adopt the scheduler's next dispatch round (type/data_pass).
+        Returns None on job shutdown. Blocks until the epoch advances past
+        the one this pool last worked."""
+        while True:
+            r = self.client.call(op="epoch")
+            if r.get("shutdown"):
+                return None
+            if r.get("round") is not None and r["epoch"] > self.epoch:
+                self.epoch = r["epoch"]
+                self.round = r["round"]
+                return self.round
+            if not wait:
+                return None
+            time.sleep(self.poll)
+
+    def get(self, node: str = "") -> Optional[tuple[int, File]]:
+        while True:
+            r = self.client.call(op="get", epoch=self.epoch)
+            if "part_id" in r:
+                f = File(**r["file"])
+                return r["part_id"], f
+            if r.get("done"):
+                return None
+            time.sleep(self.poll)
+
+    def finish(self, part_id: int, progress: Optional[dict] = None) -> None:
+        self.client.call(op="finish", part_id=part_id, epoch=self.epoch,
+                         progress=progress or {})

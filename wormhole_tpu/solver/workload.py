@@ -134,14 +134,17 @@ class WorkloadPool:
             p.update(state=1, node=node, t_start=time.monotonic())
             return i, p["file"]
 
-    def finish(self, part_id: int) -> None:
+    def finish(self, part_id: int) -> bool:
+        """Mark done; False if a straggler twin already finished it (the
+        caller must not double-count its progress)."""
         with self._lock:
             p = self._parts[part_id]
             if p["state"] == 2:
-                return  # straggler twin already finished it
+                return False
             p["state"] = 2
             self._durations.append(time.monotonic() - p["t_start"])
             self.num_finished += 1
+            return True
 
     def reset(self, node: str) -> int:
         """Re-queue parts assigned to a dead node; returns count."""
