@@ -24,6 +24,8 @@ def main():
     data = sys.argv[1]
     crash_rank = int(sys.argv[2]) if len(sys.argv) > 2 else -1
     env = node_env()
+    if env.role.value == "server":
+        return 0  # fake workload needs no parameter servers
     if env.role.value == "scheduler":
         sched = Scheduler.from_env(env)
         sched.node_timeout = 3.0

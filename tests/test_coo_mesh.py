@@ -1,0 +1,99 @@
+"""Mesh-sharded Pallas COO kernels: the tile grid shard_map'ed over the
+model axis and rows over the data axis must reproduce the XLA segment-op
+path exactly (interpret mode, f32) — the ZPull/ZPush key-sharded layout
+of reference async_sgd.h:277-287 on a real mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+from wormhole_tpu.ops import coo_kernels as ck
+from wormhole_tpu.parallel.mesh import make_mesh
+
+from conftest import synth_libsvm_text
+
+NB = 2 * ck.TILE  # 2 tiles -> one per model shard on a 2-wide model axis
+
+
+def _random_coo(rng, nnz, num_rows, num_buckets):
+    idx = rng.integers(0, num_buckets, size=nnz).astype(np.int32)
+    seg = np.sort(rng.integers(0, num_rows, size=nnz)).astype(np.int32)
+    val = rng.normal(size=nnz).astype(np.float32)
+    return idx, seg, val
+
+
+def test_pack_mesh_coo_partitions_exactly():
+    rng = np.random.default_rng(0)
+    num_rows, D, M = 256, 2, 2
+    idx, seg, val = _random_coo(rng, 1000, num_rows, NB)
+    cap = ck.mesh_capacity(4096, D, M)
+    mc = ck.pack_mesh_coo(idx, seg, val, NB, num_rows, D, M, cap)
+    assert mc.dropped_nnz == 0
+    # every live nonzero lands in exactly one cell with local coordinates
+    total = 0
+    for d in range(D):
+        for m in range(M):
+            live = mc.sval[d, m] != 0
+            total += int(live.sum())
+            assert (mc.sidx[d, m][live] < NB // M).all()
+            assert (mc.sseg[d, m][live] < num_rows // D).all()
+    assert total == int((val != 0).sum())
+
+
+@pytest.mark.parametrize("D,M", [(2, 2), (2, 1), (1, 2)])
+def test_mesh_spmv_matches_dense(D, M):
+    rng = np.random.default_rng(1)
+    num_rows = 256
+    idx, seg, val = _random_coo(rng, 2000, num_rows, NB)
+    w = rng.normal(size=NB).astype(np.float32)
+    d_vec = rng.normal(size=num_rows).astype(np.float32)
+
+    mesh = make_mesh(D, M)
+    cap = ck.mesh_capacity(4096, D, M)
+    mc = ck.pack_mesh_coo(idx, seg, val, NB, num_rows, D, M, cap)
+    args = tuple(jnp.asarray(x) for x in
+                 (mc.sidx, mc.sseg, mc.sval, mc.tmap, mc.first))
+
+    xw = ck.mesh_coo_spmv(mesh, jnp.asarray(w), *args, num_rows)
+    want_xw = np.zeros(num_rows, np.float32)
+    np.add.at(want_xw, seg, val * w[idx])
+    np.testing.assert_allclose(np.asarray(xw), want_xw, rtol=2e-5,
+                               atol=1e-5)
+
+    g = ck.mesh_coo_spmv_t(mesh, jnp.asarray(d_vec), *args, NB)
+    want_g = np.zeros(NB, np.float32)
+    np.add.at(want_g, idx, val * d_vec[seg])
+    np.testing.assert_allclose(np.asarray(g), want_g, rtol=2e-5, atol=1e-5)
+
+
+def test_learner_pallas_matches_xla_on_2x2_mesh(tmp_path):
+    """kernel=pallas on a 2x2 mesh trains the same model as kernel=xla
+    (VERDICT r1 item 3 done-criterion)."""
+    p = tmp_path / "t.libsvm"
+    p.write_text(synth_libsvm_text(n_rows=512, n_feat=200, nnz_per_row=10,
+                                   seed=3))
+    common = dict(minibatch=256, num_buckets=NB, nnz_per_row=16,
+                  algo="ftrl", lr_eta=0.5, lambda_l1=0.5,
+                  kernel_dtype="f32")
+    lrn_x = LinearLearner(LinearConfig(kernel="xla", **common),
+                          make_mesh(2, 2))
+    lrn_p = LinearLearner(LinearConfig(kernel="pallas", **common),
+                          make_mesh(2, 2))
+    assert lrn_p.use_pallas and lrn_p._mesh_coo
+    for blk in MinibatchIter(str(p), minibatch_size=256):
+        px = lrn_x.train_batch(blk)
+        pp = lrn_p.train_batch(blk)
+        np.testing.assert_allclose(pp["logloss"], px["logloss"], rtol=1e-4)
+    wx = lrn_x.store.to_numpy()
+    wp = lrn_p.store.to_numpy()
+    for k in wx:
+        np.testing.assert_allclose(wp[k], wx[k], rtol=1e-4, atol=1e-6)
+    # predict agrees too
+    blk = next(iter(MinibatchIter(str(p), minibatch_size=256)))
+    np.testing.assert_allclose(lrn_p.predict_batch(blk),
+                               lrn_x.predict_batch(blk),
+                               rtol=1e-4, atol=1e-5)

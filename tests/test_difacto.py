@@ -130,7 +130,7 @@ def test_solver_checkpoint_both_tables(fm_file, tmp_path):
         model_out=str(tmp_path / "m/fm"))
     fm = DifactoLearner(cfg, make_mesh(1, 1))
     MinibatchSolver(fm, cfg, verbose=False).run()
-    loaded = dict(np.load(str(tmp_path / "m/fm_part-0.npz")))
+    loaded = dict(np.load(str(tmp_path / "m/fm.npz")))
     assert set(loaded) == {"w", "z", "n", "cnt", "V", "nV"}
     assert loaded["V"].shape == (256, 4)
 

@@ -140,3 +140,29 @@ def test_agaricus_three_pass_convergence(agaricus):
     auc = tot["auc"] / tot["nex"]
     acc = tot["acc"] / tot["nex"]
     assert auc > 0.99 and acc > 0.95, (auc, acc)
+
+
+def test_new_w_tracks_model_sparsity(synth_file):
+    """The train step's device-side new_w deltas must sum to the model's
+    |w|_0 (reference linear progress.h:10-35 / async_sgd.h:35-41)."""
+    cfg = LinearConfig(minibatch=128, num_buckets=1 << 10, nnz_per_row=16,
+                       algo="ftrl", lr_eta=0.5, lambda_l1=2.0)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    new_w_sum = 0.0
+    for blk in MinibatchIter(synth_file, fmt="libsvm", minibatch_size=128):
+        p = lrn.train_batch(blk)
+        assert "new_w" in p and "clk" in p and "pclk" in p
+        new_w_sum += p["new_w"]
+    assert int(new_w_sum) == lrn.nnz()
+
+
+def test_prob_predict_is_sigmoid_of_margin(synth_file):
+    cfg = LinearConfig(minibatch=128, num_buckets=1 << 10, nnz_per_row=16)
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    blk = next(iter(MinibatchIter(synth_file, minibatch_size=128)))
+    lrn.train_batch(blk)
+    margins = lrn.predict_batch(blk)
+    lrn.cfg.prob_predict = True
+    probs = lrn.predict_batch(blk)
+    np.testing.assert_allclose(probs, 1 / (1 + np.exp(-margins)), rtol=1e-6)
+    assert ((probs > 0) & (probs < 1)).all()

@@ -125,7 +125,7 @@ def test_solver_end_to_end(data_dir, tmp_path):
     assert result["train"].value("nex") == 1200
     assert result["val"].value("nex") == 400
     assert result["val"].mean("auc") > 0.85
-    assert os.path.exists(str(tmp_path / "model/out_part-0.npz"))
+    assert os.path.exists(str(tmp_path / "model/out.npz"))
 
 
 def test_solver_model_roundtrip(data_dir, tmp_path):
@@ -180,9 +180,10 @@ def test_checkpoint_iter_naming(data_dir, tmp_path):
     lrn = LinearLearner(cfg, make_mesh(1, 1))
     MinibatchSolver(lrn, cfg, verbose=False).run()
     names = sorted(os.listdir(tmp_path / "model"))
-    # intermediate save at pass 2 (iter-1) + final
-    assert "out_iter-1_part-0.npz" in names
-    assert "out_part-0.npz" in names
+    # intermediate save at pass 2 (iter-1) + final; single shard writes
+    # the plain <base>.npz form
+    assert "out_iter-1.npz" in names
+    assert "out.npz" in names
 
 
 def test_checkpoint_reshard_removes_stale_parts(data_dir, tmp_path):

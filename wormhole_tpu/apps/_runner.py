@@ -2,19 +2,25 @@
 
 The reference's minibatch apps are a scheduler/server/worker triple over
 ps-lite (reference linear.cc:6-25 role dispatch; minibatch_solver.h:85-195
-scheduler loop; :284-329 worker loop). Here:
+scheduler loop; :284-329 worker loop; servers async_sgd.h:200-226). Here:
 
 - no role env (the common case): single process drives the full solver on
   the local device mesh — scheduler, "servers" (sharded tables in HBM)
   and worker in one.
 - scheduler role: owns the control plane — per-pass workload rounds,
-  merged progress rows, early stop, shutdown announcement.
-- worker role: a MinibatchSolver whose pool is the scheduler's RemotePool;
-  model state is device-resident per worker process. On a pod slice each
-  worker is one host of the global mesh (jax.distributed); in the
-  single-machine integration harness each worker holds a replica and
-  trains its share of parts — the async-PS throughput model, with
-  worker 0 saving the model (the reference's per-rank part naming).
+  merged progress rows, early stop, model save commands to the server
+  group, shutdown announcement.
+- server role: a runtime.ps_server.ServerNode owning a bucket-range shard
+  of every state table; workers push deltas / pull merged state through
+  it, so ALL workers train ONE model (the defining ps-lite semantic,
+  async_sgd.h:240-288). Staleness is bounded by the `max_delay` knob:
+  a worker trains at most max_delay minibatches between syncs.
+- worker role: a MinibatchSolver whose pool is the scheduler's
+  RemotePool; device state syncs against the server group per part and
+  every max_delay minibatches.
+
+With `-s 0` (no servers) workers fall back to independent replicas — a
+file-throughput test mode only; rank 0 alone saves its replica.
 """
 
 from __future__ import annotations
@@ -23,6 +29,7 @@ import sys
 import time
 
 from wormhole_tpu.config import load_config
+from wormhole_tpu.runtime.ps_server import PSClient, ServerNode, SyncedStore
 from wormhole_tpu.runtime.tracker import (
     RemotePool, Scheduler, SchedulerClient, node_env,
 )
@@ -50,6 +57,8 @@ def run_minibatch_app(cfg, make_learner, verbose: bool = True) -> dict:
         return MinibatchSolver(learner, cfg, verbose=verbose).run()
     if env.role.value == "scheduler":
         return _run_scheduler(cfg, env, verbose)
+    if env.role.value == "server":
+        return _run_server(cfg, env)
     return _run_worker(cfg, env, make_learner, verbose)
 
 
@@ -71,12 +80,49 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
                 if verbose:
                     print(f"validation pass {dp}", flush=True)
                 result["val"] = sched.wait_round(cfg.print_sec, t0, verbose)
-        sched.announce_shutdown()
-        # let workers observe shutdown + save before the server dies
-        time.sleep(1.0)
+        if "val" in result:
+            # machine-readable final metrics line (the tutorial log's final
+            # row, criteo_kaggle.rst:78)
+            v = result["val"]
+            print(f"final val: logloss={v.mean('logloss'):.6f} "
+                  f"auc={v.mean('auc'):.6f} acc={v.mean('acc'):.6f}",
+                  flush=True)
+        # command the server group to save its shards, then release
+        # everyone (IterScheduler::SaveModel -> kServerGroup parity)
+        if env.num_servers > 0:
+            ps = PSClient([u for u in _server_uris(sched)])
+            if cfg.model_out:
+                paths = ps.save(cfg.model_out)
+                if verbose:
+                    print(f"model saved: {paths}", flush=True)
+            sched.announce_shutdown()
+            time.sleep(1.0)
+            ps.shutdown()
+        else:
+            sched.announce_shutdown()
+            time.sleep(1.0)
         return result
     finally:
         sched.stop()
+
+
+def _server_uris(sched: Scheduler) -> list[str]:
+    with sched._lock:
+        return [sched._server_uris[r] for r in sorted(sched._server_uris)]
+
+
+def _run_server(cfg, env) -> dict:
+    """One ps server process: bucket-range shard owner."""
+    node = ServerNode(env.rank, env.num_servers)
+    node.serve()
+    client = SchedulerClient(env.scheduler_uri, f"server-{env.rank}")
+    client.call(op="register_server", rank=env.rank, uri=node.uri)
+    try:
+        while not node.wait_shutdown(2.0):
+            client.call(op="epoch")  # liveness ping
+    finally:
+        node.stop()
+    return {}
 
 
 def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
@@ -87,16 +133,48 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
     if cfg.model_in:
         ckpt.load_model(_store(learner), cfg.model_in,
                         cfg.load_iter if cfg.load_iter >= 0 else None)
+    synced = None
+    if env.num_servers > 0:
+        deadline = time.monotonic() + 60.0
+        while not (s := client.call(op="servers"))["ready"]:
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"only {len(s['uris'])}/{s['num_servers']} ps servers "
+                    "registered within 60s — a server process likely died "
+                    "at startup")
+            time.sleep(0.2)
+        ps = PSClient(s["uris"])
+        synced = SyncedStore(
+            _store(learner), ps,
+            max_delay=getattr(cfg, "max_delay", 16),
+            fixed_bytes=getattr(cfg, "fixed_bytes", 0))
+        synced.init()
     solver = MinibatchSolver(learner, cfg, verbose=False)
     result = {}
     while (rnd := pool.sync_round()) is not None:
         wtype = WorkType(rnd["type"])
-        prog = _drain_round(solver, learner, pool, wtype, rnd["data_pass"])
+        if synced is not None:
+            # adopt the merged model at round start (val rounds then score
+            # the shared model, not this worker's replica)
+            synced.pull()
+            if env.rank == 0 and hasattr(learner, "nnz"):
+                # seed the scheduler's fresh round Progress with the
+                # shared model's standing |w|_0 so its printed sparsity
+                # column is cumulative like the single-process solver's
+                # (every worker just pulled the same state; one reporter
+                # avoids N-fold overcounting)
+                client.report({"new_w": float(learner.nnz())})
+        prog = _drain_round(solver, learner, pool, wtype, rnd["data_pass"],
+                            synced)
         result["train" if wtype == WorkType.TRAIN else "val"] = prog
-    if cfg.model_out:
-        # per-rank part naming, iter_solver.h:115-119
-        ckpt.save_model(_store(learner), f"{cfg.model_out}_part-{env.rank}")
+    if synced is None:
+        if cfg.model_out and env.rank == 0:
+            # replica mode: single writer (rank 0) saves its full model
+            ckpt.save_model(_store(learner), cfg.model_out)
     if getattr(cfg, "predict_out", None):
+        # the last round-end sync already pulled the merged model; the
+        # servers may have shut down by now, so predict on that state
+        # (staleness <= one other worker's final part)
         solver.predict(cfg.val_data or cfg.train_data,
                        f"{cfg.predict_out}_rank-{env.rank}")
     return result
@@ -106,32 +184,38 @@ def _store(learner):
     return getattr(learner, "ckpt_store", None) or learner.store
 
 
-def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass):
+def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass,
+                 synced=None):
     """Worker side of one dispatch round: pull parts until the round is
     globally done, stream minibatches through the learner, report summed
     progress per part (the finish RPC carries it, replacing the timed
-    ps::Slave channel)."""
+    ps::Slave channel). Training state syncs against the server group
+    every max_delay minibatches and always before a part's finish RPC —
+    so when the scheduler sees the round finished, every contribution is
+    already merged on the servers."""
     from wormhole_tpu.data.minibatch import MinibatchIter
 
     cfg = solver.cfg
     prog = Progress()
-    step = (learner.train_batch if wtype == WorkType.TRAIN
-            else learner.eval_batch)
+    train = wtype == WorkType.TRAIN
+    step = learner.train_batch if train else learner.eval_batch
     while (got := pool.get()) is not None:
         part_id, f = got
         part_prog: dict = {}
         for blk in MinibatchIter(
             f.filename, f.part, f.num_parts, f.format,
             minibatch_size=cfg.minibatch,
-            shuf_buf=(cfg.rand_shuffle * cfg.minibatch
-                      if wtype == WorkType.TRAIN else 0),
-            neg_sampling=(cfg.neg_sampling
-                          if wtype == WorkType.TRAIN else 1.0),
+            shuf_buf=(cfg.rand_shuffle * cfg.minibatch if train else 0),
+            neg_sampling=(cfg.neg_sampling if train else 1.0),
             seed=data_pass * 7919 + part_id,
         ):
             p = step(blk)
             for k, v in p.items():
                 part_prog[k] = part_prog.get(k, 0.0) + float(v)
+            if train and synced is not None:
+                synced.maybe_sync()
+        if train and synced is not None:
+            synced.sync()
         prog.merge(part_prog)
         pool.finish(part_id, part_prog)
     return prog

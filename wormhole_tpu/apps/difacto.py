@@ -16,7 +16,9 @@ from wormhole_tpu.parallel.mesh import make_mesh
 
 
 def make_learner(cfg: DifactoConfig, env):
-    mesh = make_mesh(num_model=max(env.num_servers, 1))
+    # local device mesh; cross-process model sharding is the ps server
+    # group's job (runtime/ps_server.py), not the in-process mesh's
+    mesh = make_mesh()
     return DifactoLearner(cfg, mesh)
 
 

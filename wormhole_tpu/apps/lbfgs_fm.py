@@ -48,7 +48,7 @@ def main(argv=None) -> int:
         cfg.data, mesh, cfg.data_format, cfg.minibatch, cfg.nnz_per_row,
         cfg.num_parts_per_file)
     obj = FmObjFunction(batches, num_feature, cfg.nfactor, mesh,
-                        init_sigma=cfg.init_sigma, seed=cfg.seed)
+                        init_scale=cfg.init_sigma, seed=cfg.seed)
     solver = LBFGSSolver(obj, LBFGSConfig(
         max_iter=cfg.max_lbfgs_iter, m=cfg.m, reg_l1=cfg.reg_L1,
         reg_l2=cfg.reg_L2, min_rel_decrease=cfg.lbfgs_stop_tol))

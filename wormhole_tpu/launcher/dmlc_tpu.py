@@ -10,10 +10,11 @@ Mapping the reference's launch dimensions onto TPU:
   local processes for the single-host / CPU-mesh integration tests —
   exactly how the reference tests multi-node on localhost,
   data_parallel_test.cc:8).
-- `-s` servers = model-axis shards of the parameter mesh, not separate
-  processes: the "server group" is the sharded HBM tables updated inside
-  the jitted step (SURVEY.md §2.2 ps-lite row). The value is exported as
-  WH_NUM_SERVERS and consumed as the mesh's model-axis size.
+- `-s` servers = parameter-server processes (runtime/ps_server.py): each
+  owns a bucket-range shard of every state table; workers push deltas /
+  pull merged state through them with bounded staleness, so all workers
+  train ONE model (async_sgd.h:240-288 parity). Within each worker the
+  device mesh additionally shards tables over its local devices.
 - multi-host pods: each worker also gets a rank so apps can call
   jax.distributed.initialize and form the global device mesh over
   ICI/DCN; the control plane here stays the same.
@@ -72,8 +73,10 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
                                 stderr=subprocess.STDOUT)
 
     sched = spawn("scheduler", 0)
+    servers = [spawn("server", r) for r in range(num_servers)]
     workers = [spawn("worker", r) for r in range(num_workers)]
     procs = {"scheduler": sched}
+    procs.update({f"server-{r}": p for r, p in enumerate(servers)})
     procs.update({f"worker-{r}": p for r, p in enumerate(workers)})
     threads = []
     for name, p in procs.items():
@@ -85,7 +88,7 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     try:
         rc = sched.wait()
         # give workers a grace period to drain, then terminate leftovers
-        for p in workers:
+        for p in workers + servers:
             try:
                 rc = max(rc, p.wait(timeout=10))
             except subprocess.TimeoutExpired:
@@ -109,7 +112,7 @@ def main(argv=None) -> int:
         description="local multi-process launcher (dmlc_local.py parity)")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=1,
-                    help="model-axis shards (parameter mesh dimension)")
+                    help="parameter-server processes (0 = replica mode)")
     ap.add_argument("--node-timeout", type=float, default=30.0)
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="program to launch (prefix with --)")

@@ -216,7 +216,9 @@ class DifactoLearner:
             new_vstate["V"] = jnp.where(touched_v > 0, V_new, V)
             new_vstate["nV"] = nV
 
-            prog = linmod._progress(obj, margin, label, mask)
+            new_w = (jnp.sum(new_state["w"] != 0)
+                     - jnp.sum(w != 0)).astype(jnp.float32)
+            prog = linmod._progress(obj, margin, label, mask, new_w)
             obj_w, _ = linmod._loss_dual(cfg.loss, label, xw)
             prog["objv_w"] = jnp.sum(obj_w * mask)
             return new_state, new_vstate, prog
@@ -260,7 +262,10 @@ class DifactoLearner:
     def predict_batch(self, blk: RowBlock) -> np.ndarray:
         margin, _ = self._fwd(self.store.state, self.vstore.state,
                               *self._batch(blk))
-        return np.asarray(margin)[: blk.size]
+        out = np.asarray(margin)[: blk.size]
+        if self.cfg.prob_predict:
+            out = 1.0 / (1.0 + np.exp(-out))
+        return out
 
     def nnz(self) -> int:
         return self.store.nnz("w")

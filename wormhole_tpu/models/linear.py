@@ -54,6 +54,9 @@ class LinearConfig:
     loss: str = "logit"  # logit | square_hinge
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
+    # predict output: raw margins (default) or probabilities
+    # (reference linear/loss.h:55-63 prob_prediction)
+    prob_predict: bool = False
 
     # learning rate / algorithm (config.proto:45-77)
     algo: str = "ftrl"  # ftrl | adagrad | sgd
@@ -66,6 +69,10 @@ class LinearConfig:
     rand_shuffle: int = 0  # shuffle buffer in minibatches (0 = off)
     neg_sampling: float = 1.0
     fixed_bytes: int = 0  # gradient-push quantization filter
+    # bounded staleness (reference config.proto:122 max_delay,
+    # criteo.conf:21): in the multi-process launch, the max number of
+    # minibatches a worker trains between syncs against the server group
+    max_delay: int = 16
     print_sec: int = 1
     save_iter: int = -1
     load_iter: int = -1
@@ -79,6 +86,12 @@ class LinearConfig:
     # kernel = pallas (tiled MXU COO kernels, ops/coo_kernels.py) | xla
     # (segment ops) | auto (pallas on an unsharded-table TPU run, else xla)
     kernel: str = "auto"
+    # MXU compute dtype for the pallas kernels: bf16 (half the MXU cost;
+    # table values and per-nnz gradients round to bfloat16) | f32 (exact,
+    # matches kernel=xla numerics) | auto (f32 when fixed_bytes == 0 —
+    # i.e. when gradient quantization is nominally off the kernel does not
+    # silently re-introduce rounding — else bf16)
+    kernel_dtype: str = "bf16"
 
     @property
     def row_capacity(self) -> int:
@@ -158,19 +171,35 @@ class LinearLearner:
         self.store = KVStore(self.mesh, cfg.num_buckets, _tables_for(cfg.algo))
         self._bsh1 = batch_sharding(self.mesh, 1)
         self._dropped_rows = 0
+        D = self.mesh.shape.get("data", 1)
+        M = self.mesh.shape.get("model", 1)
+        # per-shard kernel constraints: each model shard owns whole tiles,
+        # each data shard owns whole lane groups (mesh_coo_* wrappers)
+        shapes_ok = (cfg.num_buckets % (M * ck.TILE) == 0
+                     and cfg.minibatch % (D * ck.LANES) == 0)
         self.use_pallas = cfg.kernel == "pallas" or (
             cfg.kernel == "auto"
             and jax.default_backend() == "tpu"
-            and self.mesh.shape.get("model", 1) == 1
-            and self.mesh.shape.get("data", 1) == 1
-            and cfg.num_buckets % ck.TILE == 0
-            and cfg.minibatch % ck.LANES == 0
+            and shapes_ok
         )
+        # mesh layout (shard_map + psum collectives) whenever any axis > 1
+        self._mesh_coo = self.use_pallas and (D > 1 or M > 1)
+        self._shard_cap = ck.mesh_capacity(cfg.row_capacity, D, M)
         if self.use_pallas:
-            assert cfg.num_buckets % ck.TILE == 0, (
-                f"pallas kernel needs num_buckets % {ck.TILE} == 0")
-            assert cfg.minibatch % ck.LANES == 0, (
-                f"pallas kernel needs minibatch % {ck.LANES} == 0")
+            assert cfg.num_buckets % (M * ck.TILE) == 0, (
+                f"pallas kernel needs num_buckets % {M * ck.TILE} == 0")
+            assert cfg.minibatch % (D * ck.LANES) == 0, (
+                f"pallas kernel needs minibatch % {D * ck.LANES} == 0")
+        # MXU compute dtype for the COO kernels. None defers to the kernel
+        # default (bf16 on TPU, f32 in interpret mode); "auto" keeps f32
+        # whenever fixed_bytes == 0 so disabling gradient quantization also
+        # disables the kernels' bf16 rounding (ADVICE r1).
+        if cfg.kernel_dtype == "f32":
+            self._coo_dtype = jnp.float32
+        elif cfg.kernel_dtype == "auto" and cfg.fixed_bytes == 0:
+            self._coo_dtype = jnp.float32
+        else:
+            self._coo_dtype = None
 
         @partial(jax.jit, donate_argnums=0)
         def train_step(state, seg, idx, val, label, mask):
@@ -199,7 +228,9 @@ class LinearLearner:
             else:
                 touched = (raw_g != 0).astype(jnp.float32)
             new_state = _update(cfg.algo, state, g, touched, cfg)
-            prog = _progress(obj, xw, label, mask)
+            new_w = (jnp.sum(new_state["w"] != 0)
+                     - jnp.sum(w != 0)).astype(jnp.float32)
+            prog = _progress(obj, xw, label, mask, new_w)
             return new_state, prog
 
         @jax.jit
@@ -219,11 +250,52 @@ class LinearLearner:
         @partial(jax.jit, donate_argnums=0)
         def train_step_coo(state, sidx, sseg, sval, tmap, first, label, mask):
             xw = ck.coo_spmv(state["w"], sidx, sseg, sval, tmap, first,
-                             cfg.minibatch)
+                             cfg.minibatch, dtype=self._coo_dtype)
             obj, d = _loss_dual(cfg.loss, label, xw)
             d = d * mask
             g = ck.coo_spmv_t(d, sidx, sseg, sval, tmap, first,
-                              cfg.num_buckets)
+                              cfg.num_buckets, dtype=self._coo_dtype)
+            raw_g = g
+            g = quantize_push(g, cfg.fixed_bytes)
+            if cfg.algo == "ftrl":
+                touched = 1.0
+            else:
+                touched = (raw_g != 0).astype(jnp.float32)
+            new_w = -jnp.sum(state["w"] != 0).astype(jnp.float32)
+            new_state = _update(cfg.algo, state, g, touched, cfg)
+            new_w = new_w + jnp.sum(new_state["w"] != 0)
+            return new_state, _progress(obj, xw, label, mask, new_w)
+
+        @jax.jit
+        def eval_step_coo(state, sidx, sseg, sval, tmap, first, label, mask):
+            xw = ck.coo_spmv(state["w"], sidx, sseg, sval, tmap, first,
+                             cfg.minibatch, dtype=self._coo_dtype)
+            obj, _ = _loss_dual(cfg.loss, label, xw)
+            return _progress(obj, xw, label, mask)
+
+        @jax.jit
+        def predict_step_coo(state, sidx, sseg, sval, tmap, first):
+            return ck.coo_spmv(state["w"], sidx, sseg, sval, tmap, first,
+                               cfg.minibatch, dtype=self._coo_dtype)
+
+        self._train_step_coo = train_step_coo
+        self._eval_step_coo = eval_step_coo
+        self._predict_step_coo = predict_step_coo
+
+        # mesh variants: tiles shard_map'ed over the model axis, rows over
+        # the data axis; psum plays ZPull/ZPush (async_sgd.h:277-287)
+        mesh = self.mesh
+
+        @partial(jax.jit, donate_argnums=0)
+        def train_step_mcoo(state, sidx, sseg, sval, tmap, first,
+                            label, mask):
+            w = state["w"]
+            xw = ck.mesh_coo_spmv(mesh, w, sidx, sseg, sval, tmap, first,
+                                  cfg.minibatch, dtype=self._coo_dtype)
+            obj, d = _loss_dual(cfg.loss, label, xw)
+            d = d * mask
+            g = ck.mesh_coo_spmv_t(mesh, d, sidx, sseg, sval, tmap, first,
+                                   cfg.num_buckets, dtype=self._coo_dtype)
             raw_g = g
             g = quantize_push(g, cfg.fixed_bytes)
             if cfg.algo == "ftrl":
@@ -231,23 +303,28 @@ class LinearLearner:
             else:
                 touched = (raw_g != 0).astype(jnp.float32)
             new_state = _update(cfg.algo, state, g, touched, cfg)
-            return new_state, _progress(obj, xw, label, mask)
+            new_w = (jnp.sum(new_state["w"] != 0)
+                     - jnp.sum(w != 0)).astype(jnp.float32)
+            return new_state, _progress(obj, xw, label, mask, new_w)
 
         @jax.jit
-        def eval_step_coo(state, sidx, sseg, sval, tmap, first, label, mask):
-            xw = ck.coo_spmv(state["w"], sidx, sseg, sval, tmap, first,
-                             cfg.minibatch)
+        def eval_step_mcoo(state, sidx, sseg, sval, tmap, first,
+                           label, mask):
+            xw = ck.mesh_coo_spmv(mesh, state["w"], sidx, sseg, sval,
+                                  tmap, first, cfg.minibatch,
+                                  dtype=self._coo_dtype)
             obj, _ = _loss_dual(cfg.loss, label, xw)
             return _progress(obj, xw, label, mask)
 
         @jax.jit
-        def predict_step_coo(state, sidx, sseg, sval, tmap, first):
-            return ck.coo_spmv(state["w"], sidx, sseg, sval, tmap, first,
-                               cfg.minibatch)
+        def predict_step_mcoo(state, sidx, sseg, sval, tmap, first):
+            return ck.mesh_coo_spmv(mesh, state["w"], sidx, sseg, sval,
+                                    tmap, first, cfg.minibatch,
+                                    dtype=self._coo_dtype)
 
-        self._train_step_coo = train_step_coo
-        self._eval_step_coo = eval_step_coo
-        self._predict_step_coo = predict_step_coo
+        self._train_step_mcoo = train_step_mcoo
+        self._eval_step_mcoo = eval_step_mcoo
+        self._predict_step_mcoo = predict_step_mcoo
 
     # -- device batch plumbing ---------------------------------------------
     def _shard(self, *arrays):
@@ -276,6 +353,19 @@ class LinearLearner:
         db = self.make_device_batch(blk)
         if not self.use_pallas:
             return ("xla", db, blk.size)
+        if self._mesh_coo:
+            D = self.mesh.shape.get("data", 1)
+            M = self.mesh.shape.get("model", 1)
+            mc = ck.pack_mesh_coo(db.idx, db.seg, db.val,
+                                  self.cfg.num_buckets, self.cfg.minibatch,
+                                  D, M, self._shard_cap)
+            if mc.dropped_nnz:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "mesh shard overflow: dropped %d nonzeros — raise "
+                    "nnz_per_row or mesh_capacity slack", mc.dropped_nnz)
+            return ("mcoo", mc, db.label, db.row_mask, blk.size)
         p = ck.pack_sorted_coo(db.idx, db.seg, db.val, self.cfg.num_buckets,
                                capacity=self.cfg.row_capacity)
         return ("coo", p, db.label, db.row_mask, blk.size)
@@ -287,7 +377,11 @@ class LinearLearner:
 
     def train_batch(self, blk) -> dict:
         b = self._prepared(blk)
-        if b[0] == "coo":
+        if b[0] == "mcoo":
+            _, mc, label, mask, _ = b
+            self.store.state, prog = self._train_step_mcoo(
+                self.store.state, *self._mcoo_args(mc, label, mask))
+        elif b[0] == "coo":
             _, p, label, mask, _ = b
             self.store.state, prog = self._train_step_coo(
                 self.store.state, *self._coo_args(p, label, mask))
@@ -300,7 +394,11 @@ class LinearLearner:
 
     def eval_batch(self, blk) -> dict:
         b = self._prepared(blk)
-        if b[0] == "coo":
+        if b[0] == "mcoo":
+            _, mc, label, mask, _ = b
+            prog = self._eval_step_mcoo(
+                self.store.state, *self._mcoo_args(mc, label, mask))
+        elif b[0] == "coo":
             _, p, label, mask, _ = b
             prog = self._eval_step_coo(
                 self.store.state, *self._coo_args(p, label, mask))
@@ -313,7 +411,11 @@ class LinearLearner:
 
     def predict_batch(self, blk) -> np.ndarray:
         b = self._prepared(blk)
-        if b[0] == "coo":
+        if b[0] == "mcoo":
+            _, mc, _, _, size = b
+            xw = self._predict_step_mcoo(
+                self.store.state, *self._mcoo_args(mc))
+        elif b[0] == "coo":
             _, p, _, _, size = b
             xw = self._predict_step_coo(
                 self.store.state, *self._coo_args(p))
@@ -321,7 +423,10 @@ class LinearLearner:
             db, size = b[1], b[2]
             xw = self._predict_step(
                 self.store.state, *self._shard(db.seg, db.idx, db.val))
-        return np.asarray(xw)[:size]
+        out = np.asarray(xw)[:size]
+        if self.cfg.prob_predict:
+            out = 1.0 / (1.0 + np.exp(-out))
+        return out
 
     def _coo_args(self, p, label=None, mask=None):
         args = [jnp.asarray(p.idx), jnp.asarray(p.seg), jnp.asarray(p.val),
@@ -330,18 +435,36 @@ class LinearLearner:
             args += [jnp.asarray(label), jnp.asarray(mask)]
         return args
 
+    def _mcoo_args(self, mc, label=None, mask=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P("data", "model", None))
+        args = [jax.device_put(x, sh) for x in
+                (mc.sidx, mc.sseg, mc.sval, mc.tmap, mc.first)]
+        if label is not None:
+            args += [jax.device_put(label, self._bsh1),
+                     jax.device_put(mask, self._bsh1)]
+        return args
+
     def nnz(self) -> int:
         return self.store.nnz("w")
 
 
-def _progress(obj, xw, label, mask):
+def _progress(obj, xw, label, mask, new_w=None):
     """Per-batch mergeable progress vector (reference linear/progress.h:
-    objv, auc, acc, #ex; scheduler-side weighted averaging)."""
+    objv, auc, acc, #ex, new_w; scheduler-side weighted averaging).
+    clk/pclk feed the COPC column (binary_class_evaluation.h:76-85);
+    new_w is the |w|_0 delta the train step computed device-side."""
     n = jnp.sum(mask)
-    return {
+    p = {
         "objv": jnp.sum(obj * mask),
         "auc": M.auc(label, xw, mask) * n,
         "acc": M.accuracy(label, xw, mask) * n,
         "logloss": M.logloss(label, xw, mask) * n,
         "nex": n,
+        "clk": jnp.sum(label * mask),
+        "pclk": jnp.sum(jax.nn.sigmoid(xw) * mask),
     }
+    if new_w is not None:
+        p["new_w"] = new_w
+    return p

@@ -270,3 +270,134 @@ def coo_spmv_t(d, sidx, sseg, sval, tmap, first, num_buckets: int,
         interpret=_use_interpret(),
     )(tmap, first, d2, sidx, sseg, sval)
     return out.reshape(num_buckets)
+
+
+# ---------------------------------------------------------- mesh sharding
+# The 1x1-mesh kernels above generalize to a (data x model) mesh the same
+# way ps-lite shards keys across servers and examples across workers
+# (reference async_sgd.h:277-287): each model shard owns a contiguous
+# bucket range (a whole number of tiles), each data shard owns a
+# contiguous row range, and device (d, m) runs the kernel on exactly the
+# nonzeros that fall in its (row range x bucket range) cell. PULL partial
+# sums psum over the model axis; PUSH gradients psum over the data axis —
+# the two collectives that play ZPull and ZPush.
+
+
+@dataclasses.dataclass
+class MeshCOO:
+    """Per-(data, model)-shard packed COO: leading [D, M] axes are laid
+    out over the mesh; trailing axes are each shard's SortedCOO."""
+
+    sidx: np.ndarray   # [D, M, P]
+    sseg: np.ndarray   # [D, M, P] row ids local to the data shard
+    sval: np.ndarray   # [D, M, P]
+    tmap: np.ndarray   # [D, M, P/BLK]
+    first: np.ndarray  # [D, M, P/BLK]
+    dropped_nnz: int   # nonzeros beyond a shard's capacity (overflow)
+
+
+def mesh_capacity(capacity: int, D: int, M: int, slack: float = 2.0) -> int:
+    """Per-shard nnz capacity: an even split of the batch capacity across
+    the D*M cells, padded by `slack` for hash skew (keys hash ~uniformly
+    over bucket ranges — the byte-reversal spreading argument of
+    localizer.h:16-26 — so 2x covers realistic imbalance), and never less
+    than one block."""
+    per = int(capacity * slack / (D * M))
+    return max((per + BLK - 1) // BLK, 1) * BLK
+
+
+def pack_mesh_coo(idx, seg, val, num_buckets: int, num_rows: int,
+                  D: int, M: int, capacity_per_shard: int) -> MeshCOO:
+    """Split COO triples into (data, model) mesh cells and pack each cell
+    (host-side, loader threads). Zero-valued entries (padding) are
+    dropped before splitting — they contribute nothing."""
+    nb_m = num_buckets // M
+    rows_d = num_rows // D
+    assert nb_m % TILE == 0, (num_buckets, M)
+    assert rows_d % LANES == 0, (num_rows, D)
+    P = packed_size(capacity_per_shard, nb_m)
+    nblk = P // BLK
+    idx = np.asarray(idx, np.int64)
+    seg = np.asarray(seg, np.int64)
+    val = np.asarray(val, np.float32)
+    live = val != 0
+    d_of = seg // rows_d
+    m_of = idx // nb_m
+
+    sidx = np.zeros((D, M, P), np.int32)
+    sseg = np.zeros((D, M, P), np.int32)
+    sval = np.zeros((D, M, P), np.float32)
+    tmap = np.zeros((D, M, nblk), np.int32)
+    first = np.zeros((D, M, nblk), np.int32)
+    dropped = 0
+    for d in range(D):
+        for m in range(M):
+            sel = live & (d_of == d) & (m_of == m)
+            ci = idx[sel] - m * nb_m
+            cs = seg[sel] - d * rows_d
+            cv = val[sel]
+            if len(ci) > capacity_per_shard:
+                dropped += len(ci) - capacity_per_shard
+                ci = ci[:capacity_per_shard]
+                cs = cs[:capacity_per_shard]
+                cv = cv[:capacity_per_shard]
+            p = pack_sorted_coo(ci, cs, cv, nb_m,
+                                capacity=capacity_per_shard)
+            sidx[d, m] = p.idx
+            sseg[d, m] = p.seg
+            sval[d, m] = p.val
+            tmap[d, m] = p.tmap
+            first[d, m] = p.first
+    return MeshCOO(sidx, sseg, sval, tmap, first, dropped)
+
+
+def mesh_coo_spmv(mesh, w, sidx, sseg, sval, tmap, first,
+                  num_rows: int, dtype=None):
+    """xw = X w on a (data x model) mesh. w is table-sharded over the
+    model axis; returns xw sharded over the data axis. The psum over the
+    model axis is the ZPull collective."""
+    from jax.sharding import PartitionSpec as P
+
+    from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    D = mesh.shape[DATA_AXIS]
+
+    def local(w_l, si, ss, sv, tm, fi):
+        xw = coo_spmv(w_l, si[0, 0], ss[0, 0], sv[0, 0], tm[0, 0],
+                      fi[0, 0], num_rows // D, dtype=dtype)
+        return jax.lax.psum(xw, MODEL_AXIS)
+
+    coo_spec = P(DATA_AXIS, MODEL_AXIS, None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(MODEL_AXIS), coo_spec, coo_spec, coo_spec,
+                  coo_spec, coo_spec),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,  # pallas_call out_shape carries no vma
+    )(w, sidx, sseg, sval, tmap, first)
+
+
+def mesh_coo_spmv_t(mesh, d, sidx, sseg, sval, tmap, first,
+                    num_buckets: int, dtype=None):
+    """g = X^T d on a (data x model) mesh. d is row-sharded over the data
+    axis; returns g table-sharded over the model axis. The psum over the
+    data axis is the ZPush reduce."""
+    from jax.sharding import PartitionSpec as P
+
+    from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    M = mesh.shape[MODEL_AXIS]
+
+    def local(d_l, si, ss, sv, tm, fi):
+        g = coo_spmv_t(d_l, si[0, 0], ss[0, 0], sv[0, 0], tm[0, 0],
+                       fi[0, 0], num_buckets // M, dtype=dtype)
+        return jax.lax.psum(g, DATA_AXIS)
+
+    coo_spec = P(DATA_AXIS, MODEL_AXIS, None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(DATA_AXIS), coo_spec, coo_spec, coo_spec,
+                  coo_spec, coo_spec),
+        out_specs=P(MODEL_AXIS),
+        check_vma=False,  # pallas_call out_shape carries no vma
+    )(d, sidx, sseg, sval, tmap, first)

@@ -1,0 +1,29 @@
+"""Shared TCP plumbing for the runtime control/data planes.
+
+Connection establishment retries with backoff (the launcher spawns all
+node processes concurrently, so clients routinely race ahead of a
+server's bind); once a connection exists, request/response failures are
+NOT retried here — the ops they carry (barrier entry, part assignment)
+are not idempotent, so replay policy belongs to the caller.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+
+def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
+                       timeout: float = 60.0) -> socket.socket:
+    """Dial `addr`, retrying refused/unreachable connections with
+    exponential backoff until `deadline_s` elapses."""
+    deadline = time.monotonic() + deadline_s
+    backoff = 0.05
+    while True:
+        try:
+            return socket.create_connection(addr, timeout=timeout)
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 1.0)

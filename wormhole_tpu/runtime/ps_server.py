@@ -1,0 +1,399 @@
+"""Parameter-server data plane: shared model across worker processes.
+
+The reference's ps-lite servers hold THE model: every worker ZPulls the
+same server-resident weights and ZPushes gradients back, so N workers
+train one set of statistics (reference learn/linear/async_sgd.h:240-288,
+servers at :200-226; key-range sharding across `-s` server processes).
+This module is the TPU build's cross-process equivalent:
+
+- `-s` server processes each own a contiguous bucket-range shard of every
+  state table (the ps-lite key-shard layout; rows n*r//S .. n*(r+1)//S of
+  each array, matching utils/checkpoint.py's part split so server part
+  files ARE checkpoint part files).
+- Workers train on their local device mesh and synchronize through the
+  servers with **bounded staleness**: every `max_delay` minibatches a
+  worker pushes the additive delta of its state tables since its last
+  pull and pulls the merged state back. For FTRL the (z, n) tables are
+  exactly additive in the pushed gradients, so delta-merging reproduces
+  async-SGD semantics with staleness <= max_delay minibatches per worker
+  (the reference's max_delay knob, difacto guide/criteo.conf:21, bounds
+  the same quantity in units of in-flight minibatches).
+- The wire is a length-prefixed binary protocol over TCP; pushes are
+  optionally quantized on the wire (fixed_bytes: 2 = bfloat16 bits,
+  1 = int8 + scale — the FIXING_FLOAT/TRUNCATE filter parity,
+  async_sgd.h:290-301) so the filter actually reduces bandwidth, not
+  just rounding.
+
+Server discovery rides the scheduler control plane: servers register
+their URI (op=register_server), workers poll op=servers until all `-s`
+URIs are known.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from wormhole_tpu.runtime.net import connect_with_retry
+
+# ------------------------------------------------------------ wire format
+# Frame = 4-byte big-endian header length | JSON header | raw payload.
+# header = {"op": str, ...meta, "arrays": [{"name", "shape", "enc",
+#           "scale", "nbytes"}, ...]}; payload = buffers concatenated in
+# array order.
+
+
+def _encode(a: np.ndarray, fixed_bytes: int = 0) -> tuple[dict, bytes]:
+    """Encode one f32 array for the wire. fixed_bytes: 0 = raw f32,
+    2 = bfloat16 bit-truncation (round-to-nearest-even), 1 = absmax int8."""
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    meta = {"shape": list(a.shape)}
+    if fixed_bytes == 0:
+        buf = a.tobytes()
+        meta.update(enc="raw", nbytes=len(buf))
+        return meta, buf
+    if fixed_bytes >= 2:
+        u = a.view(np.uint32)
+        # round-to-nearest-even to the high 16 bits (bfloat16)
+        rounded = (u + 0x7FFF + ((u >> 16) & 1)) >> 16
+        buf = rounded.astype(np.uint16).tobytes()
+        meta.update(enc="bf16", nbytes=len(buf))
+        return meta, buf
+    scale = float(max(np.max(np.abs(a), initial=0.0), 1e-30) / 127.0)
+    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    buf = q.tobytes()
+    meta.update(enc="int8", scale=scale, nbytes=len(buf))
+    return meta, buf
+
+
+def _decode(meta: dict, buf: bytes) -> np.ndarray:
+    shape = tuple(meta["shape"])
+    enc = meta["enc"]
+    if enc == "raw":
+        return np.frombuffer(buf, np.float32).reshape(shape).copy()
+    if enc == "bf16":
+        u = np.frombuffer(buf, np.uint16).astype(np.uint32) << 16
+        return u.view(np.float32).reshape(shape).copy()
+    if enc == "int8":
+        q = np.frombuffer(buf, np.int8).astype(np.float32)
+        return (q * meta["scale"]).reshape(shape)
+    raise ValueError(f"unknown encoding {enc!r}")
+
+
+def _read_exact(sock_file, n: int) -> Optional[bytes]:
+    chunks = []
+    while n > 0:
+        c = sock_file.read(n)
+        if not c:
+            return None
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def send_frame(sock_file, header: dict,
+               arrays: Optional[dict[str, np.ndarray]] = None,
+               fixed_bytes: int = 0) -> None:
+    metas, bufs = [], []
+    for name, a in (arrays or {}).items():
+        m, b = _encode(a, fixed_bytes)
+        m["name"] = name
+        metas.append(m)
+        bufs.append(b)
+    header = dict(header, arrays=metas)
+    h = json.dumps(header).encode()
+    sock_file.write(struct.pack(">I", len(h)))
+    sock_file.write(h)
+    for b in bufs:
+        sock_file.write(b)
+    sock_file.flush()
+
+
+def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray]]]:
+    raw = _read_exact(sock_file, 4)
+    if raw is None:
+        return None
+    (hlen,) = struct.unpack(">I", raw)
+    h = _read_exact(sock_file, hlen)
+    if h is None:
+        return None
+    header = json.loads(h)
+    arrays = {}
+    for m in header.get("arrays", []):
+        buf = _read_exact(sock_file, m["nbytes"])
+        if buf is None:
+            return None
+        arrays[m["name"]] = _decode(m, buf)
+    return header, arrays
+
+
+def shard_range(n: int, rank: int, world: int) -> tuple[int, int]:
+    """Row range of server `rank`: the same even split checkpoint part
+    files use (utils/checkpoint.py), so parts reassemble by rank order."""
+    return n * rank // world, n * (rank + 1) // world
+
+
+# ---------------------------------------------------------------- server
+class _PSHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            got = recv_frame(self.rfile)
+            if got is None:
+                return
+            header, arrays = got
+            resp_header, resp_arrays = self.server.node._dispatch(  # type: ignore
+                header, arrays)
+            send_frame(self.wfile, resp_header, resp_arrays)
+            if header.get("op") == "shutdown":
+                self.server.node._shutdown.set()  # type: ignore
+                return
+
+
+class _PSServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ServerNode:
+    """One `-s` server process: owns its bucket-range slice of every state
+    table. Tables are created by the first `init` push (set-if-absent;
+    workers init deterministically so any winner is equivalent); `push`
+    adds deltas; `pull` returns current slices; `save` writes this
+    server's shard as a checkpoint part file."""
+
+    def __init__(self, rank: int, world: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.rank = rank
+        self.world = world
+        self.tables: dict[str, np.ndarray] = {}
+        self.full_rows: dict[str, int] = {}  # full-table row counts
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._srv = _PSServer((host, port), _PSHandler)
+        self._srv.node = self  # type: ignore
+        self.num_push = 0
+        self.num_pull = 0
+
+    @property
+    def uri(self) -> str:
+        h, p = self._srv.server_address[:2]
+        return f"{h}:{p}"
+
+    def serve(self) -> None:
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+
+    def wait_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._shutdown.wait(timeout)
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- ops ----------------------------------------------------------------
+    def _dispatch(self, header: dict, arrays: dict) -> tuple[dict, dict]:
+        op = header.get("op")
+        if op == "init":
+            with self._lock:
+                known = bool(self.tables)
+                if not known:
+                    for k, v in arrays.items():
+                        self.tables[k] = v.astype(np.float32)
+                    self.full_rows = {
+                        k: int(n) for k, n in header["full_rows"].items()}
+            return {"ok": True, "known": known}, {}
+        if op == "pull":
+            with self._lock:
+                self.num_pull += 1
+                out = {k: v.copy() for k, v in self.tables.items()}
+            return {"ok": True}, out
+        if op == "push":
+            with self._lock:
+                self.num_push += 1
+                for k, d in arrays.items():
+                    if k not in self.tables:
+                        return {"error": f"push to unknown table {k}"}, {}
+                    self.tables[k] += d
+            return {"ok": True}, {}
+        if op == "save":
+            path = self._save(header["base"], header.get("iter"))
+            return {"ok": True, "path": path}, {}
+        if op == "stats":
+            with self._lock:
+                return {"ok": True, "num_push": self.num_push,
+                        "num_pull": self.num_pull,
+                        "tables": {k: list(v.shape)
+                                   for k, v in self.tables.items()}}, {}
+        if op == "shutdown":
+            return {"ok": True}, {}
+        return {"error": f"unknown op {op!r}"}, {}
+
+    def _save(self, base: str, it: Optional[int]) -> str:
+        import glob
+        import re
+
+        from wormhole_tpu.utils.checkpoint import atomic_savez, part_name
+
+        os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+        with self._lock:
+            tables = {k: v.copy() for k, v in self.tables.items()}
+        prefix = part_name(base, it, 0)[: -len("_part-0")]
+        if self.rank == 0:
+            # remove stale files from a previous save with a different
+            # shard count (the invariant utils/checkpoint.save_model
+            # keeps): only rank 0 cleans, and only files NO current
+            # server writes, so concurrent part writes are never raced
+            if self.world > 1 and os.path.exists(prefix + ".npz"):
+                os.remove(prefix + ".npz")
+            for old in glob.glob(prefix + "_part-*.npz"):
+                r = int(re.search(r"_part-(\d+)\.npz$", old).group(1))
+                if r >= self.world or self.world <= 1:
+                    os.remove(old)
+        if self.world <= 1:
+            path = prefix + ".npz"
+        else:
+            path = part_name(base, it, self.rank) + ".npz"
+        atomic_savez(path, compressed=True, **tables)
+        return path
+
+
+# ---------------------------------------------------------------- client
+class PSClient:
+    """Worker-side stub over all servers: splits each table by the
+    servers' row ranges, keeps one persistent connection per server."""
+
+    def __init__(self, uris: list[str], connect_deadline: float = 30.0):
+        self.uris = list(uris)
+        self.world = len(uris)
+        self._socks: list[Optional[socket.socket]] = [None] * self.world
+        self._files = [None] * self.world
+        self.connect_deadline = connect_deadline
+
+    def _file(self, r: int):
+        if self._files[r] is None:
+            host, port = self.uris[r].rsplit(":", 1)
+            s = connect_with_retry((host, int(port)), self.connect_deadline)
+            self._socks[r] = s
+            self._files[r] = s.makefile("rwb")
+        return self._files[r]
+
+    def _rpc(self, r: int, header: dict, arrays=None, fixed_bytes: int = 0):
+        f = self._file(r)
+        try:
+            send_frame(f, header, arrays, fixed_bytes)
+            got = recv_frame(f)
+        except OSError:
+            self.close(r)
+            raise
+        if got is None:
+            self.close(r)
+            raise ConnectionResetError(f"server {self.uris[r]} closed")
+        h, arrs = got
+        if "error" in h:
+            raise RuntimeError(f"ps server error: {h['error']}")
+        return h, arrs
+
+    def close(self, r: Optional[int] = None) -> None:
+        ranks = range(self.world) if r is None else [r]
+        for i in ranks:
+            try:
+                if self._socks[i] is not None:
+                    self._socks[i].close()
+            except OSError:
+                pass
+            self._socks[i] = None
+            self._files[i] = None
+
+    # -- table ops ----------------------------------------------------------
+    def _slices(self, tables: dict[str, np.ndarray], r: int):
+        out = {}
+        for k, v in tables.items():
+            lo, hi = shard_range(v.shape[0], r, self.world)
+            out[k] = v[lo:hi]
+        return out
+
+    def init(self, tables: dict[str, np.ndarray]) -> None:
+        full_rows = {k: int(v.shape[0]) for k, v in tables.items()}
+        for r in range(self.world):
+            self._rpc(r, {"op": "init", "full_rows": full_rows},
+                      self._slices(tables, r))
+
+    def pull(self) -> dict[str, np.ndarray]:
+        parts = [self._rpc(r, {"op": "pull"})[1] for r in range(self.world)]
+        return {
+            k: np.concatenate([p[k] for p in parts], axis=0)
+            if self.world > 1 else parts[0][k]
+            for k in parts[0]
+        }
+
+    def push(self, deltas: dict[str, np.ndarray],
+             fixed_bytes: int = 0) -> None:
+        for r in range(self.world):
+            self._rpc(r, {"op": "push"}, self._slices(deltas, r),
+                      fixed_bytes=fixed_bytes)
+
+    def save(self, base: str, it: Optional[int] = None) -> list[str]:
+        return [self._rpc(r, {"op": "save", "base": base, "iter": it})[0]
+                ["path"] for r in range(self.world)]
+
+    def stats(self, r: int = 0) -> dict:
+        return self._rpc(r, {"op": "stats"})[0]
+
+    def shutdown(self) -> None:
+        for r in range(self.world):
+            try:
+                self._rpc(r, {"op": "shutdown"})
+            except (OSError, ConnectionError):
+                pass
+        self.close()
+
+
+class SyncedStore:
+    """Bounded-staleness synchronization of a learner's KV store against
+    the server group: tracks the state at last pull and pushes additive
+    deltas (cur - base). `maybe_sync` counts minibatches and syncs every
+    `max_delay` (the reference's bounded-async knob)."""
+
+    def __init__(self, store, client: PSClient, max_delay: int = 16,
+                 fixed_bytes: int = 0):
+        self.store = store
+        self.client = client
+        self.max_delay = max(int(max_delay), 1)
+        self.fixed_bytes = fixed_bytes
+        self._base: dict[str, np.ndarray] = {}
+        self._steps = 0
+        self.num_syncs = 0
+
+    def init(self) -> None:
+        """Offer this worker's (deterministic) init state, then adopt the
+        authoritative server state."""
+        self.client.init(self.store.to_numpy())
+        self.pull()
+
+    def pull(self) -> None:
+        pulled = self.client.pull()
+        self.store.from_numpy(pulled)
+        self._base = pulled
+
+    def sync(self) -> None:
+        cur = self.store.to_numpy()
+        deltas = {k: cur[k] - self._base[k] for k in cur}
+        self.client.push(deltas, fixed_bytes=self.fixed_bytes)
+        self.pull()
+        self._steps = 0
+        self.num_syncs += 1
+
+    def maybe_sync(self) -> bool:
+        self._steps += 1
+        if self._steps >= self.max_delay:
+            self.sync()
+            return True
+        return False

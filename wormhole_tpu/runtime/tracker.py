@@ -33,6 +33,7 @@ import time
 from enum import Enum
 from typing import Optional
 
+from wormhole_tpu.runtime.net import connect_with_retry
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
 
@@ -94,10 +95,13 @@ class Scheduler:
     the liveness table. Start with serve(); stop() shuts down."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 node_timeout: float = 30.0, straggler: bool = True):
+                 node_timeout: float = 30.0, straggler: bool = True,
+                 num_servers: int = 0):
         self.pool = WorkloadPool()
         self.progress = Progress()
         self.node_timeout = node_timeout
+        self.num_servers = num_servers
+        self._server_uris: dict[int, str] = {}   # ps server rank -> uri
         self._lock = threading.Lock()
         self._nodes: dict[str, float] = {}       # node -> last seen
         self._barriers: dict[str, set] = {}      # name -> arrived nodes
@@ -144,19 +148,29 @@ class Scheduler:
         return Scheduler(
             host=host, port=int(port),
             node_timeout=float(os.environ.get("WH_NODE_TIMEOUT", "30")),
+            num_servers=env.num_servers,
         )
 
     # -- dispatch round management -----------------------------------------
     def start_round(self, pattern: str, num_parts_per_file: int,
                     fmt: str, wtype: WorkType, data_pass: int) -> int:
         """Load a pass's file parts into the pool (StartDispatch parity,
-        data_parallel.h:93-115)."""
+        data_parallel.h:93-115). Ordering matters both ways: the epoch is
+        bumped BEFORE the pool refills so a worker still polling the old
+        round can never be handed a new-round part under the old round's
+        semantics (its stale-epoch `get` returns {wait}), and a new-epoch
+        worker polling mid-fill sees the empty pool as not-finished
+        (WorkloadPool.is_finished) rather than as an instantly-over
+        round."""
         self.pool.clear()
         self.progress = Progress()
         with self._lock:
             self._epoch += 1
             self._round = dict(type=int(wtype), data_pass=data_pass)
-        return self.pool.add(pattern, num_parts_per_file, fmt)
+        n = self.pool.add(pattern, num_parts_per_file, fmt)
+        if n == 0:
+            raise FileNotFoundError(f"no files match {pattern}")
+        return n
 
     def wait_round(self, print_sec: float = 1.0, t0: Optional[float] = None,
                    verbose: bool = True) -> Progress:
@@ -181,6 +195,20 @@ class Scheduler:
             self._nodes[node] = time.monotonic()
         if op == "register":
             return {"ok": True, "epoch": self._epoch}
+        if op == "register_server":
+            # a ps server announces its push/pull endpoint (the ps-lite
+            # node-manager rendezvous role)
+            with self._lock:
+                self._server_uris[int(req["rank"])] = req["uri"]
+            return {"ok": True}
+        if op == "servers":
+            # workers poll until the full `-s` group is up
+            with self._lock:
+                ready = len(self._server_uris) >= self.num_servers
+                uris = [self._server_uris[r]
+                        for r in sorted(self._server_uris)] if ready else []
+            return {"ready": ready, "uris": uris,
+                    "num_servers": self.num_servers}
         if op == "get":
             if req.get("epoch") != self._epoch:
                 # worker is in an older round; tell it to resync
@@ -201,12 +229,15 @@ class Scheduler:
                        and self.pool.finish(req["part_id"]))
             # a straggler twin's duplicate finish is dropped so its
             # progress is not double-counted (at-least-once execution,
-            # exactly-once accounting)
+            # exactly-once accounting); merges run under the lock since
+            # handler threads are concurrent
             if counted and req.get("progress"):
-                self.progress.merge(req["progress"])
+                with self._lock:
+                    self.progress.merge(req["progress"])
             return {"ok": True}
         if op == "report":  # pure progress push (ps::Slave channel)
-            self.progress.merge(req.get("progress", {}))
+            with self._lock:
+                self.progress.merge(req.get("progress", {}))
             return {"ok": True}
         if op == "epoch":
             return {"epoch": self._epoch,
@@ -255,19 +286,31 @@ class Scheduler:
 class SchedulerClient:
     """Worker-side RPC stub."""
 
-    def __init__(self, uri: str, node: str, timeout: float = 60.0):
+    def __init__(self, uri: str, node: str, timeout: float = 60.0,
+                 connect_deadline: float = 30.0):
         host, port = uri.rsplit(":", 1)
         self.addr = (host, int(port))
         self.node = node
         self.timeout = timeout
+        self.connect_deadline = connect_deadline
 
     def call(self, **req) -> dict:
+        """One RPC. Only connection ESTABLISHMENT retries (the launcher
+        spawns workers concurrently with the scheduler, so a worker's
+        first register() may race ahead of the scheduler's bind, ADVICE
+        r1); once connected, a lost reply raises rather than replaying —
+        ops like barrier entry and part assignment are not idempotent."""
         req.setdefault("node", self.node)
-        with socket.create_connection(self.addr, timeout=self.timeout) as s:
+        payload = json.dumps(req) + "\n"
+        with connect_with_retry(self.addr, self.connect_deadline,
+                                self.timeout) as s:
             f = s.makefile("rw")
-            f.write(json.dumps(req) + "\n")
+            f.write(payload)
             f.flush()
-            resp = json.loads(f.readline())
+            line = f.readline()
+        if not line:
+            raise ConnectionResetError("empty scheduler reply")
+        resp = json.loads(line)
         if "error" in resp:
             raise RuntimeError(f"scheduler error: {resp['error']}")
         return resp
@@ -327,6 +370,12 @@ class RemotePool:
                 f = File(**r["file"])
                 return r["part_id"], f
             if r.get("done"):
+                return None
+            if r.get("epoch", self.epoch) != self.epoch:
+                # the scheduler has moved on to a newer round: this round
+                # is over for us — fall back to sync_round (a worker
+                # descheduled across the round change must not spin here
+                # forever, ADVICE r1)
                 return None
             time.sleep(self.poll)
 

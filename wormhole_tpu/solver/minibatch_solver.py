@@ -94,6 +94,13 @@ class MinibatchSolver:
         if nfiles == 0:
             raise FileNotFoundError(f"no files match {data}")
         prog = Progress()
+        if hasattr(self.learner, "nnz"):
+            # seed the pass with the model's standing |w|_0 so the row's
+            # sparsity column is cumulative across passes like the
+            # reference log (progress.h:10-35), not per-pass deltas;
+            # one host reduction per pass, not per row
+            prog.merge({"new_w": float(self.learner.nnz())})
+            prog.take_increment()
         q: queue.Queue = queue.Queue(maxsize=self.max_queued)
         _END = object()
         errors: list[BaseException] = []

@@ -42,14 +42,23 @@ class Progress:
 
     @staticmethod
     def header() -> str:
+        # column parity with the reference training log (linear
+        # progress.h:10-35; criteo_kaggle.rst:66-75): |w|_0 is the running
+        # model sparsity (cumulative new_w deltas the train step reports
+        # device-side), COPC = clicks over expected clicks
+        # (binary_class_evaluation.h:76-85)
         return (f"{'time':>8} {'#total_ex':>12} {'#inc_ex':>10} "
-                f"{'logloss':>9} {'accuracy':>9} {'auc':>9}")
+                f"{'|w|_0':>10} {'logloss':>9} {'accuracy':>9} "
+                f"{'auc':>9} {'copc':>7}")
 
     def row(self, t0: float) -> str:
         inc = self.take_increment()
         n = inc.get("nex", 0.0)
         def m(k):
             return inc.get(k, 0.0) / n if n else 0.0
+        pclk = inc.get("pclk", 0.0)
+        copc = inc.get("clk", 0.0) / pclk if pclk else 0.0
         return (f"{time.time() - t0:8.1f} {self.tot.get('nex', 0):12.0f} "
-                f"{n:10.0f} {m('logloss'):9.5f} {m('acc'):9.5f} "
-                f"{m('auc'):9.5f}")
+                f"{n:10.0f} {self.tot.get('new_w', 0):10.0f} "
+                f"{m('logloss'):9.5f} {m('acc'):9.5f} "
+                f"{m('auc'):9.5f} {copc:7.4f}")

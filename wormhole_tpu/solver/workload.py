@@ -157,8 +157,12 @@ class WorkloadPool:
         return n
 
     def is_finished(self) -> bool:
+        """An empty pool is NOT finished — it is a pool that has not been
+        filled (or was just cleared mid-round-change); callers polling it
+        must keep waiting rather than conclude the round is over."""
         with self._lock:
-            return all(p["state"] == 2 for p in self._parts)
+            return bool(self._parts) and all(
+                p["state"] == 2 for p in self._parts)
 
     def pending(self) -> int:
         with self._lock:

@@ -39,14 +39,19 @@ def part_name(base: str, it: Optional[int], rank: int) -> str:
 
 def save_model(store, base: str, it: Optional[int] = None) -> list[str]:
     """Write one npz per model shard (reference SaveModel task fan-out).
-    Stale part files from a previous save with more shards are removed so
-    a later load never concatenates mixed-generation parts."""
+    A single-shard model is written as plain `<base>[_iter-K].npz` (the
+    demo-conf contract); multi-shard saves use the `_part-R` fan-out.
+    Stale files from a previous save with a different shard count are
+    removed so a later load never concatenates mixed-generation parts."""
     os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
     prefix = part_name(base, it, 0)[: -len("_part-0")]
-    for old in glob.glob(prefix + "_part-*.npz"):
+    for old in glob.glob(prefix + "_part-*.npz") + glob.glob(prefix + ".npz"):
         os.remove(old)
     arrays = store.to_numpy()
     nshards = store.mesh.shape.get("model", 1)
+    if nshards <= 1:
+        atomic_savez(prefix + ".npz", compressed=True, **arrays)
+        return [prefix + ".npz"]
     out = []
     for r in range(nshards):
         shard = {}
@@ -60,18 +65,26 @@ def save_model(store, base: str, it: Optional[int] = None) -> list[str]:
     return out
 
 
-def load_model(store, base: str, it: Optional[int] = None) -> None:
-    """Read all part files of a checkpoint into the store (any shard
-    count: parts concatenate on the bucket axis)."""
+def load_parts(base: str, it: Optional[int] = None) -> dict[str, np.ndarray]:
+    """Read a checkpoint written with any shard count — either the plain
+    `<base>.npz` single file or `_part-R` files concatenated on the bucket
+    axis — into full-model numpy arrays."""
     prefix = part_name(base, it, 0)[: -len("_part-0")]
+    if os.path.exists(prefix + ".npz"):
+        return dict(np.load(prefix + ".npz"))
     paths = sorted(
         glob.glob(prefix + "_part-*.npz"),
         key=lambda p: int(re.search(r"_part-(\d+)\.npz$", p).group(1)),
     )
     if not paths:
-        raise FileNotFoundError(f"no checkpoint parts match {prefix}_part-*")
+        raise FileNotFoundError(
+            f"no checkpoint matches {prefix}.npz or {prefix}_part-*")
     parts = [dict(np.load(p)) for p in paths]
-    merged = {
+    return {
         k: np.concatenate([p[k] for p in parts], axis=0) for k in parts[0]
     }
-    store.from_numpy(merged)
+
+
+def load_model(store, base: str, it: Optional[int] = None) -> None:
+    """Read a checkpoint (single file or parts) into the store."""
+    store.from_numpy(load_parts(base, it))
