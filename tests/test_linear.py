@@ -166,3 +166,73 @@ def test_prob_predict_is_sigmoid_of_margin(synth_file):
     probs = lrn.predict_batch(blk)
     np.testing.assert_allclose(probs, 1 / (1 + np.exp(-margins)), rtol=1e-6)
     assert ((probs > 0) & (probs < 1)).all()
+
+
+# ---------------------------------------------------- unique-key compaction
+def test_pack_unique_coo_roundtrip():
+    """pack_unique_coo maps (uniq, compact slot) back to the original
+    bucket ids exactly, and drops overflow nonzeros when the unique count
+    exceeds u_cap."""
+    from wormhole_tpu.ops import coo_kernels as ck
+
+    rng = np.random.default_rng(3)
+    nb = 64 * ck.TILE
+    nnz = 400000
+    idx = rng.integers(0, nb, size=nnz).astype(np.int64)
+    seg = rng.integers(0, 128, size=nnz).astype(np.int32)
+    val = rng.normal(size=nnz).astype(np.float32)
+    uc = ck.pack_unique_coo(idx, seg, val, nb, u_cap=8 * ck.TILE,
+                            capacity=nnz)
+    assert uc.dropped_nnz == 0
+    live = uc.coo.val != 0
+    # reconstruct original bucket ids from compact slots
+    orig = uc.uniq[uc.coo.idx[live]]
+    np.testing.assert_array_equal(np.sort(orig), np.sort(idx[val != 0]))
+    # overflow: tiny u_cap drops nonzeros and reports them
+    uc2 = ck.pack_unique_coo(idx, seg, val, nb, u_cap=ck.TILE,
+                             capacity=nnz)
+    assert uc2.dropped_nnz > 0
+    assert (uc2.coo.val != 0).sum() + uc2.dropped_nnz == (val != 0).sum()
+
+
+@pytest.mark.parametrize("algo", ["ftrl", "adagrad"])
+def test_compacted_matches_xla(synth_file, algo):
+    """The unique-key-compacted (Localizer) path must train identically to
+    the dense XLA path: same per-pass metrics and same final table, while
+    touching only O(unique keys) state per step (reference per-key server
+    updates, async_sgd.h:160-175)."""
+    from wormhole_tpu.ops import coo_kernels as ck
+
+    def run(kernel, compact_cap):
+        cfg = LinearConfig(minibatch=128, num_buckets=8 * ck.TILE,
+                           nnz_per_row=16, algo=algo, lr_eta=0.5,
+                           lambda_l1=0.5, kernel=kernel,
+                           compact_cap=compact_cap, kernel_dtype="f32")
+        lrn = LinearLearner(cfg, make_mesh(1, 1))
+        return _train_passes(lrn, synth_file, passes=2), lrn
+
+    p_x, l_x = run("xla", 0)
+    p_r, l_r = run("pallas", ck.TILE)
+    assert l_r._compact_cap == ck.TILE and l_r._ucoo_steps is not None
+    assert abs(p_x["logloss"] - p_r["logloss"]) < 1e-3
+    assert abs(p_x["auc"] - p_r["auc"]) < 1e-3
+    w_x = l_x.store.to_numpy()["w"]
+    w_r = l_r.store.to_numpy()["w"]
+    np.testing.assert_allclose(w_x, w_r, rtol=1e-3, atol=1e-5)
+
+
+def test_compacted_predict_and_eval(synth_file):
+    from wormhole_tpu.ops import coo_kernels as ck
+
+    cfg = LinearConfig(minibatch=128, num_buckets=8 * ck.TILE,
+                       nnz_per_row=16, algo="ftrl", lr_eta=0.5,
+                       kernel="pallas", compact_cap=ck.TILE,
+                       kernel_dtype="f32")
+    lrn = LinearLearner(cfg, make_mesh(1, 1))
+    _train_passes(lrn, synth_file, passes=1)
+    blk = next(iter(MinibatchIter(synth_file, minibatch_size=64)))
+    margins = lrn.predict_batch(blk)
+    assert margins.shape == (64,)
+    acc = ((margins > 0) == (blk.label > 0.5)).mean()
+    ev = lrn.eval_batch(blk)
+    np.testing.assert_allclose(acc, ev["acc"] / ev["nex"], atol=1e-6)

@@ -154,3 +154,35 @@ def test_synced_store_quantized_wire(group):
     got = client.pull()["w"]
     # bf16-rounded delta, not exact
     np.testing.assert_allclose(got, np.full(8, 0.1), rtol=1e-2)
+
+
+def test_derived_w_resolved_from_merged_z(group):
+    """FTRL's w is soft-threshold-nonlinear in (z, n): two workers can
+    each push delta-w = 0 (their local z stayed under the L1 threshold)
+    while the MERGED z crosses it. The server must re-derive w from the
+    merged (z, n), not additively merge the zero deltas (the r1 advisor
+    finding on SyncedStore)."""
+    nodes, client = group
+    n_rows = 8
+    lam = 1.0
+    spec = {"w": {"kind": "ftrl_prox", "lr_eta": 0.5, "lr_beta": 1.0,
+                  "lambda_l1": lam, "lambda_l2": 0.0}}
+    zeros = {k: np.zeros(n_rows, np.float32) for k in ("w", "z", "n")}
+    client.init(zeros, derived=spec)
+    # two workers each push z-delta 0.9 (below lam) and w-delta 0
+    for _ in range(2):
+        client.push({"w": np.zeros(n_rows, np.float32),
+                     "z": np.full(n_rows, 0.9, np.float32),
+                     "n": np.full(n_rows, 0.25, np.float32)})
+    got = client.pull()
+    np.testing.assert_allclose(got["z"], 1.8, rtol=1e-6)
+    # merged z = 1.8 > lam: w must now be the prox solution, not 0
+    eta = (1.0 + np.sqrt(0.5)) / 0.5
+    want_w = -(1.8 - lam) / eta
+    np.testing.assert_allclose(got["w"], want_w, rtol=1e-5)
+    # and a save must write the derived w too
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        client.save(os.path.join(d, "model"))
+        parts = load_parts(os.path.join(d, "model"))
+        np.testing.assert_allclose(parts["w"], want_w, rtol=1e-5)

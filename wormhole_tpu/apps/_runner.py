@@ -139,15 +139,16 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
         while not (s := client.call(op="servers"))["ready"]:
             if time.monotonic() >= deadline:
                 raise RuntimeError(
-                    f"only {len(s['uris'])}/{s['num_servers']} ps servers "
-                    "registered within 60s — a server process likely died "
-                    "at startup")
+                    f"only {s.get('num_known', 0)}/{s['num_servers']} ps "
+                    "servers registered within 60s — a server process "
+                    "likely died at startup")
             time.sleep(0.2)
         ps = PSClient(s["uris"])
         synced = SyncedStore(
             _store(learner), ps,
             max_delay=getattr(cfg, "max_delay", 16),
-            fixed_bytes=getattr(cfg, "fixed_bytes", 0))
+            fixed_bytes=getattr(cfg, "fixed_bytes", 0),
+            derived=getattr(learner, "derived_tables", dict)())
         synced.init()
     solver = MinibatchSolver(learner, cfg, verbose=False)
     result = {}

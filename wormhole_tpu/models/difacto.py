@@ -235,6 +235,15 @@ class DifactoLearner:
         self._fwd = fwd
         self._rng = jax.random.PRNGKey(seed + 17)
 
+    def derived_tables(self) -> dict:
+        """w trains by FTRL (async_sgd.h:262-286): non-additive prox of
+        the additive (z, n), recomputed server-side (see
+        LinearLearner.derived_tables)."""
+        cfg = self.cfg
+        return {"w": {"kind": "ftrl_prox", "lr_eta": cfg.lr_eta,
+                      "lr_beta": cfg.lr_beta, "lambda_l1": cfg.lambda_l1,
+                      "lambda_l2": cfg.lambda_l2}}
+
     # -- plumbing ----------------------------------------------------------
     def _batch(self, blk: RowBlock):
         cfg = self.cfg

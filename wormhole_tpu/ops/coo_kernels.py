@@ -44,10 +44,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental.pallas import tpu as pltpu
 
-TILE_HI = 512          # sublane rows per table tile
+import os
+
+# Tile geometry. The per-block cost is dominated by materializing the
+# (BLK, TILE_HI) one-hot gather/scatter operands on the VPU, so smaller
+# tiles are cheaper per block as long as the MXU matmuls stay large
+# enough; the env overrides exist for hardware tuning sweeps.
+TILE_HI = int(os.environ.get("WORMHOLE_TILE_HI", 512))  # sublanes per tile
 LANES = 128
-TILE = TILE_HI * LANES  # buckets per table tile (64k)
-BLK = 4096             # nnz per grid block
+TILE = TILE_HI * LANES  # buckets per table tile
+BLK = int(os.environ.get("WORMHOLE_BLK", 4096))  # nnz per grid block
 
 
 def _use_interpret() -> bool:
@@ -270,6 +276,63 @@ def coo_spmv_t(d, sidx, sseg, sval, tmap, first, num_buckets: int,
         interpret=_use_interpret(),
     )(tmap, first, d2, sidx, sseg, sval)
     return out.reshape(num_buckets)
+
+
+# --------------------------------------------------- unique-key compaction
+# At Criteo-1TB table sizes (>=2^26 buckets) a minibatch touches a tiny,
+# hash-spread fraction of the table: ~60k unique buckets scattered across
+# all of it. Processing the table densely (one padding block per tile
+# above, plus an O(num_buckets) optimizer sweep) then scales with the
+# table, not the batch — the exact failure the reference avoids by
+# updating only pushed keys on its servers (async_sgd.h:160-175). The
+# compacted path is the TPU analog of the reference Localizer
+# (learn/base/localizer.h:42-221): map the batch's unique bucket ids to a
+# dense [0, u_cap) slot space, gather those entries of the state tables
+# into a compact table, run the SAME kernels over the compact domain
+# (whose tile count is ~nnz/TILE instead of num_buckets/TILE), update
+# there, and scatter the entries back. Step cost becomes O(unique keys),
+# ~flat in table size — ZPull/ZPush of exactly the minibatch's keys
+# (async_sgd.h:277-287).
+
+
+@dataclasses.dataclass
+class UniqueCOO:
+    """A minibatch packed over the unique-key-compacted domain."""
+
+    uniq: np.ndarray   # (u_cap,) int32 unique bucket ids, sorted; padding
+    #                    = num_buckets (out of bounds: gathers clamp
+    #                    harmlessly, scatters drop)
+    coo: SortedCOO     # packed over the compact domain [0, u_cap)
+    num_uniq: int      # how many entries of `uniq` are real
+    dropped_nnz: int   # nonzeros dropped because uniques overflowed u_cap
+
+
+def pack_unique_coo(idx, seg, val, num_buckets: int, u_cap: int,
+                    capacity: int | None = None) -> UniqueCOO:
+    """Localize the batch's bucket ids (ops/localizer.py — the reference
+    Localizer's sort+unique+remap) and pack the COO triples over the
+    compact domain (host-side, loader threads — the reference runs its
+    Localizer there too)."""
+    assert u_cap % TILE == 0, f"u_cap must be a multiple of {TILE}"
+    assert num_buckets < 2**31, "sentinel id must fit int32"
+    from wormhole_tpu.ops.localizer import localize
+
+    idx = np.asarray(idx, np.int64)
+    seg = np.asarray(seg, np.int32)
+    val = np.asarray(val, np.float32)
+    loc = localize(idx.astype(np.uint64))
+    uniq = loc.uniq_keys.astype(np.int64)
+    slot = loc.local_index
+    dropped = 0
+    if len(uniq) > u_cap:
+        keep = slot < u_cap
+        dropped = int(np.count_nonzero(~keep))
+        seg, val, slot = seg[keep], val[keep], slot[keep]
+        uniq = uniq[:u_cap]
+    out_uniq = np.full(u_cap, num_buckets, np.int32)
+    out_uniq[: len(uniq)] = uniq
+    p = pack_sorted_coo(slot, seg, val, u_cap, capacity=capacity)
+    return UniqueCOO(out_uniq, p, len(uniq), dropped)
 
 
 # ---------------------------------------------------------- mesh sharding

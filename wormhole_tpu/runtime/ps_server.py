@@ -174,6 +174,12 @@ class ServerNode:
         self.world = world
         self.tables: dict[str, np.ndarray] = {}
         self.full_rows: dict[str, int] = {}  # full-table row counts
+        # derived-table specs ({name: {"kind": "ftrl_prox", ...}}): tables
+        # that are NOT additive in worker pushes but are pure functions of
+        # additive ones (FTRL's w = prox(z, n)); recomputed server-side
+        # after merges so pulls/saves never expose an inconsistent pair
+        self.derived: dict[str, dict] = {}
+        self._derived_dirty = False
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         self._srv = _PSServer((host, port), _PSHandler)
@@ -209,10 +215,12 @@ class ServerNode:
                         self.tables[k] = v.astype(np.float32)
                     self.full_rows = {
                         k: int(n) for k, n in header["full_rows"].items()}
+                    self.derived = header.get("derived") or {}
             return {"ok": True, "known": known}, {}
         if op == "pull":
             with self._lock:
                 self.num_pull += 1
+                self._recompute_derived()
                 out = {k: v.copy() for k, v in self.tables.items()}
             return {"ok": True}, out
         if op == "push":
@@ -221,7 +229,12 @@ class ServerNode:
                 for k, d in arrays.items():
                     if k not in self.tables:
                         return {"error": f"push to unknown table {k}"}, {}
+                    if k in self.derived:
+                        # non-additive derived tables ignore pushed deltas;
+                        # they are recomputed from their additive sources
+                        continue
                     self.tables[k] += d
+                self._derived_dirty = True
             return {"ok": True}, {}
         if op == "save":
             path = self._save(header["base"], header.get("iter"))
@@ -236,16 +249,38 @@ class ServerNode:
             return {"ok": True}, {}
         return {"error": f"unknown op {op!r}"}, {}
 
+    def _recompute_derived(self) -> None:
+        """Recompute derived tables from their additive sources (caller
+        holds the lock). FTRL's w is soft-threshold-nonlinear in (z, n),
+        so additively merged worker deltas cannot represent it: a key
+        whose merged z crosses the L1 threshold must re-solve the prox
+        even though every worker pushed delta-w = 0."""
+        if not self._derived_dirty:
+            return
+        for k, spec in self.derived.items():
+            if spec["kind"] == "ftrl_prox":
+                z, n = self.tables["z"], self.tables["n"]
+                eta = (spec["lr_beta"] + np.sqrt(n)) / spec["lr_eta"]
+                mag = np.maximum(np.abs(z) - spec["lambda_l1"], 0.0)
+                self.tables[k] = (np.sign(-z) * mag
+                                  / (eta + spec["lambda_l2"])
+                                  ).astype(np.float32)
+            else:
+                raise ValueError(f"unknown derived kind {spec['kind']!r}")
+        self._derived_dirty = False
+
     def _save(self, base: str, it: Optional[int]) -> str:
         import glob
         import re
 
-        from wormhole_tpu.utils.checkpoint import atomic_savez, part_name
+        from wormhole_tpu.utils.checkpoint import (atomic_savez, part_name,
+                                                   save_prefix)
 
         os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
         with self._lock:
+            self._recompute_derived()
             tables = {k: v.copy() for k, v in self.tables.items()}
-        prefix = part_name(base, it, 0)[: -len("_part-0")]
+        prefix = save_prefix(base, it)
         if self.rank == 0:
             # remove stale files from a previous save with a different
             # shard count (the invariant utils/checkpoint.save_model
@@ -320,10 +355,12 @@ class PSClient:
             out[k] = v[lo:hi]
         return out
 
-    def init(self, tables: dict[str, np.ndarray]) -> None:
+    def init(self, tables: dict[str, np.ndarray],
+             derived: Optional[dict] = None) -> None:
         full_rows = {k: int(v.shape[0]) for k, v in tables.items()}
         for r in range(self.world):
-            self._rpc(r, {"op": "init", "full_rows": full_rows},
+            self._rpc(r, {"op": "init", "full_rows": full_rows,
+                          "derived": derived or {}},
                       self._slices(tables, r))
 
     def pull(self) -> dict[str, np.ndarray]:
@@ -363,11 +400,14 @@ class SyncedStore:
     `max_delay` (the reference's bounded-async knob)."""
 
     def __init__(self, store, client: PSClient, max_delay: int = 16,
-                 fixed_bytes: int = 0):
+                 fixed_bytes: int = 0, derived: Optional[dict] = None):
         self.store = store
         self.client = client
         self.max_delay = max(int(max_delay), 1)
         self.fixed_bytes = fixed_bytes
+        # non-additive derived-table specs forwarded to the servers (e.g.
+        # FTRL's w = prox(z, n); see ServerNode._recompute_derived)
+        self.derived = derived or {}
         self._base: dict[str, np.ndarray] = {}
         self._steps = 0
         self.num_syncs = 0
@@ -375,7 +415,7 @@ class SyncedStore:
     def init(self) -> None:
         """Offer this worker's (deterministic) init state, then adopt the
         authoritative server state."""
-        self.client.init(self.store.to_numpy())
+        self.client.init(self.store.to_numpy(), derived=self.derived)
         self.pull()
 
     def pull(self) -> None:

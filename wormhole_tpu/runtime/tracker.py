@@ -208,6 +208,7 @@ class Scheduler:
                 uris = [self._server_uris[r]
                         for r in sorted(self._server_uris)] if ready else []
             return {"ready": ready, "uris": uris,
+                    "num_known": len(self._server_uris),
                     "num_servers": self.num_servers}
         if op == "get":
             if req.get("epoch") != self._epoch:

@@ -10,35 +10,45 @@ the solver prints the same style of row.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
 
 class Progress:
     """Summed metric vector with reference-style row formatting
-    (linear progress.h:10-35: #ex, logloss, acc, auc columns)."""
+    (linear progress.h:10-35: #ex, logloss, acc, auc columns).
+
+    Thread-safe: the scheduler merges from concurrent RPC handler threads
+    while its main thread reads rows (ps::Root monitor parity)."""
 
     def __init__(self):
         self.tot: dict[str, float] = {}
         self._last: dict[str, float] = {}
+        self._lock = threading.Lock()
 
     def merge(self, p: dict) -> None:
-        for k, v in p.items():
-            self.tot[k] = self.tot.get(k, 0.0) + float(v)
+        with self._lock:
+            for k, v in p.items():
+                self.tot[k] = self.tot.get(k, 0.0) + float(v)
 
     def value(self, key: str) -> float:
-        return self.tot.get(key, 0.0)
+        with self._lock:
+            return self.tot.get(key, 0.0)
 
     def mean(self, key: str) -> float:
-        n = self.tot.get("nex", 0.0)
-        return self.tot.get(key, 0.0) / n if n else 0.0
+        with self._lock:
+            n = self.tot.get("nex", 0.0)
+            return self.tot.get(key, 0.0) / n if n else 0.0
 
     # incremental view: metrics since last row (the reference prints
     # per-interval increments, criteo_kaggle.rst:66-75)
     def take_increment(self) -> dict[str, float]:
-        inc = {k: v - self._last.get(k, 0.0) for k, v in self.tot.items()}
-        self._last = dict(self.tot)
-        return inc
+        with self._lock:
+            inc = {k: v - self._last.get(k, 0.0)
+                   for k, v in self.tot.items()}
+            self._last = dict(self.tot)
+            return inc
 
     @staticmethod
     def header() -> str:

@@ -37,6 +37,13 @@ def part_name(base: str, it: Optional[int], rank: int) -> str:
     return s + f"_part-{rank}"
 
 
+def save_prefix(base: str, it: Optional[int]) -> str:
+    """The `<base>[_iter-K]` prefix all part files of one save share —
+    the single source of the naming contract (reference iter_solver.h:
+    115-119 `_iter-K_part-R`)."""
+    return part_name(base, it, 0)[: -len("_part-0")]
+
+
 def save_model(store, base: str, it: Optional[int] = None) -> list[str]:
     """Write one npz per model shard (reference SaveModel task fan-out).
     A single-shard model is written as plain `<base>[_iter-K].npz` (the
@@ -44,7 +51,7 @@ def save_model(store, base: str, it: Optional[int] = None) -> list[str]:
     Stale files from a previous save with a different shard count are
     removed so a later load never concatenates mixed-generation parts."""
     os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
-    prefix = part_name(base, it, 0)[: -len("_part-0")]
+    prefix = save_prefix(base, it)
     for old in glob.glob(prefix + "_part-*.npz") + glob.glob(prefix + ".npz"):
         os.remove(old)
     arrays = store.to_numpy()
@@ -69,7 +76,7 @@ def load_parts(base: str, it: Optional[int] = None) -> dict[str, np.ndarray]:
     """Read a checkpoint written with any shard count — either the plain
     `<base>.npz` single file or `_part-R` files concatenated on the bucket
     axis — into full-model numpy arrays."""
-    prefix = part_name(base, it, 0)[: -len("_part-0")]
+    prefix = save_prefix(base, it)
     if os.path.exists(prefix + ".npz"):
         return dict(np.load(prefix + ".npz"))
     paths = sorted(
