@@ -142,3 +142,110 @@ def test_launcher_worker_crash_recovers(tmp_path):
         2, [sys.executable, "tests/data_par_app.py", data, "1"])
     assert "crashing deliberately" in r.stdout, r.stdout
     assert "finished; progress n=8" in r.stdout, r.stdout
+
+
+def test_pool_node_affinity():
+    """Parts with a capable-node set only go to those nodes
+    (reference workload_pool.h:141,155)."""
+    from wormhole_tpu.solver.workload import WorkloadPool
+
+    pool = WorkloadPool()
+    pool.add_files(["a"], 2, node="w0")
+    pool.add_files(["b"], 2, node="w1")
+    pool.add_files(["a"], 2, node="w1")  # replicated file: both capable
+    pool.add_files(["c"], 2)             # no affinity: anyone
+    got = []
+    while (g := pool.get("w0")) is not None:
+        got.append(g[1].filename)
+        pool.finish(g[0])
+    # w0 may take a (own) and c (free) but never b
+    assert "b" not in got and "a" in got and "c" in got
+    while (g := pool.get("w1")) is not None:
+        pool.finish(g[0])
+    assert pool.is_finished()
+
+
+def test_pool_assign_stable_is_deterministic():
+    from wormhole_tpu.solver.workload import WorkloadPool
+
+    def run():
+        pool = WorkloadPool()
+        pool.add_files(["a", "b", "c"], 2)
+        pool.assign_stable(["worker-0", "worker-1"])
+        owner = {}
+        for w in ("worker-0", "worker-1"):
+            while (g := pool.get(w)) is not None:
+                owner[(g[1].filename, g[1].part)] = w
+                pool.finish(g[0])
+        return owner
+
+    o1, o2 = run(), run()
+    assert o1 == o2                      # stable across passes
+    assert set(o1.values()) == {"worker-0", "worker-1"}
+    counts = [list(o1.values()).count(w) for w in set(o1.values())]
+    assert max(counts) - min(counts) <= 1  # even n/num_workers split
+
+
+def test_local_data_round_respects_affinity(tmp_path):
+    """Worker-local data (reference data_parallel.h:82,96-100): each
+    worker matches the pattern against its OWN directory; the scheduler
+    only dispatches a part to a worker that reported it."""
+    d0 = tmp_path / "n0"; d0.mkdir()
+    d1 = tmp_path / "n1"; d1.mkdir()
+    for i in range(3):
+        (d0 / f"part-{i}").write_text("")
+        (d1 / f"part-{i + 3}").write_text("")
+
+    sched = Scheduler(node_timeout=10)
+    sched.serve()
+    try:
+        n = sched.start_round("{LOCAL}/part-.*", 1, "libsvm",
+                              WorkType.TRAIN, 0, local_data=True)
+        assert n == 0  # scheduler does not match files itself
+
+        seen = {}
+
+        def worker(rank, local_dir):
+            c = SchedulerClient(sched.uri, f"worker-{rank}")
+            c.register()
+            pool = RemotePool(c, poll=0.02)
+            pool.sync_round()
+            # patch the worker-side matcher to its own directory: the
+            # {LOCAL} pattern stands in for a per-node mount
+            import wormhole_tpu.runtime.tracker as T
+
+            orig_get = pool.get
+
+            def get(node=""):
+                while True:
+                    r = pool.client.call(op="get", epoch=pool.epoch)
+                    if "part_id" in r:
+                        from wormhole_tpu.solver.workload import File
+                        return r["part_id"], File(**r["file"])
+                    if "match" in r:
+                        import glob
+                        files = sorted(glob.glob(f"{local_dir}/part-*"))
+                        pool.client.call(op="add_local", files=files,
+                                         epoch=pool.epoch)
+                        continue
+                    if r.get("done"):
+                        return None
+                    time.sleep(0.02)
+
+            while (got := get()) is not None:
+                part_id, f = got
+                assert os.path.dirname(f.filename) == str(local_dir), (
+                    f"worker-{rank} handed foreign part {f.filename}")
+                seen.setdefault(rank, []).append(f.filename)
+                pool.finish(part_id, {"nex": 1.0})
+
+        ts = [threading.Thread(target=worker, args=(0, d0)),
+              threading.Thread(target=worker, args=(1, d1))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert sched.pool.is_finished()
+        assert len(seen[0]) == 3 and len(seen[1]) == 3
+    finally:
+        sched.stop()

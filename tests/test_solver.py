@@ -238,3 +238,48 @@ def test_checkpoint_missing_raises(tmp_path):
     lrn = LinearLearner(cfg, make_mesh(1, 1))
     with pytest.raises(FileNotFoundError):
         ckpt.load_model(lrn.store, str(tmp_path / "nope"))
+
+
+def test_perf_accounting_and_pass_summary(tmp_path, capsys):
+    """The solver logs FinishMinibatch-style pass summaries (avg step
+    time + io/comm overhead share, reference minibatch_solver.h:246-275)
+    and classifies op timings difacto-Perf-style (async_sgd.h:108-127)."""
+    from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+    from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+    from wormhole_tpu.utils.perf import Perf
+
+    p = tmp_path / "d.libsvm"
+    p.write_text(synth_libsvm_text(n_rows=600, n_feat=100, nnz_per_row=8,
+                                   seed=3))
+    cfg = LinearConfig(train_data=str(p).replace(".libsvm", r"\.libsvm"),
+                       minibatch=128, num_buckets=1 << 10, nnz_per_row=16,
+                       max_data_pass=1)
+    solver = MinibatchSolver(LinearLearner(cfg), cfg, verbose=True)
+    solver.run()
+    out = capsys.readouterr().out
+    assert "io/comm overhead" in out and "ms/step" in out
+    assert solver.perf.count("train_step") > 0
+    assert solver.perf.count("wait") > 0
+    assert solver.perf.mean_ms("train_step") > 0
+
+    # Perf unit behavior: periodic row logging
+    rows = []
+    pf = Perf(log=rows.append, log_every=4)
+    for _ in range(8):
+        pf.add("op_a", 0.001)
+    assert len(rows) == 2 and "op_a" in rows[0]
+
+
+def test_profile_trace_env(tmp_path, monkeypatch):
+    """WORMHOLE_PROFILE_DIR wraps the run in a JAX profiler trace."""
+    import os
+
+    from wormhole_tpu.utils.perf import maybe_trace
+
+    out = tmp_path / "trace"
+    monkeypatch.setenv("WORMHOLE_PROFILE_DIR", str(out))
+    import jax.numpy as jnp
+    with maybe_trace("t"):
+        float(jnp.sum(jnp.arange(8.0)))
+    files = [os.path.join(r, f) for r, _, fs in os.walk(out) for f in fs]
+    assert files, "no profiler output written"

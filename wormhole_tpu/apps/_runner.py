@@ -70,7 +70,11 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
     try:
         for dp in range(cfg.max_data_pass):
             n = sched.start_round(cfg.train_data, cfg.num_parts_per_file,
-                                  cfg.data_format, WorkType.TRAIN, dp)
+                                  cfg.data_format, WorkType.TRAIN, dp,
+                                  local_data=getattr(cfg, "local_data",
+                                                     False),
+                                  dispatch=getattr(cfg, "dispatch",
+                                                   "online"))
             if verbose:
                 print(f"training pass {dp}: {n} files", flush=True)
             result["train"] = sched.wait_round(cfg.print_sec, t0, verbose)
@@ -151,6 +155,8 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
             derived=getattr(learner, "derived_tables", dict)())
         synced.init()
     solver = MinibatchSolver(learner, cfg, verbose=False)
+    if synced is not None:
+        synced.perf = solver.perf
     result = {}
     while (rnd := pool.sync_round()) is not None:
         wtype = WorkType(rnd["type"])

@@ -58,13 +58,22 @@ def load_batches(pattern: str, mesh, fmt: str = "libsvm",
 
 
 class _BatchObjBase:
-    """Shared accumulate-over-batches eval/grad driver."""
+    """Shared accumulate-over-batches eval/grad driver.
+
+    The flat parameter vector is sharded over ALL mesh devices — the
+    reference's rank partition of the weight vector and its history basis
+    (lbfgs.h:127-136, 557-645). num_dim is zero-padded up to a multiple
+    of the device count (named shardings need even splits); the padding
+    is provably inert: it starts 0, receives 0 gradient (no data column
+    references it), has l1_mask 0, and every solver update is a linear
+    combination of such vectors."""
 
     def __init__(self, batches, mesh):
         self.batches = batches
         self.mesh = mesh
-        self._psh = NamedSharding(mesh, P())  # params replicated; XLA
-        # partitions the batch loss over the data axis
+        ndev = mesh.size
+        self.num_dim_padded = -(-self.num_dim // ndev) * ndev
+        self._psh = NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
         loss = self._batch_loss
 
@@ -92,7 +101,17 @@ class _BatchObjBase:
         return g
 
     def place(self, p):
+        pad = self.num_dim_padded - p.shape[0]
+        if pad:
+            p = jnp.concatenate([p, jnp.zeros(pad, p.dtype)])
         return jax.device_put(p, self._psh)
+
+    def pad_mask(self, m):
+        """Extend a logical-length mask to the padded vector (padding 0)."""
+        pad = self.num_dim_padded - m.shape[0]
+        if pad:
+            m = jnp.concatenate([m, jnp.zeros(pad, m.dtype)])
+        return m
 
 
 class LinearObjFunction(_BatchObjBase):
@@ -117,7 +136,7 @@ class LinearObjFunction(_BatchObjBase):
 
     def l1_mask(self):
         m = jnp.ones(self.num_dim, jnp.float32)
-        return m.at[self.num_feature].set(0.0)  # no L1 on bias
+        return self.pad_mask(m.at[self.num_feature].set(0.0))  # no L1 on bias
 
     def predict(self, p, seg, idx, val, num_rows: int):
         return self._margin(p, seg, idx, val, num_rows)
@@ -137,7 +156,9 @@ class FmObjFunction(_BatchObjBase):
 
     def _split(self, p):
         d, k = self.num_feature, self.k
-        return p[:d], p[d : d + d * k].reshape(d, k), p[-1]
+        # bias lives at its layout slot, not p[-1]: the vector may carry
+        # sharding padding past it
+        return p[:d], p[d : d + d * k].reshape(d, k), p[d + d * k]
 
     def _margin(self, p, seg, idx, val, num_rows: int):
         w, V, bias = self._split(p)
@@ -165,7 +186,7 @@ class FmObjFunction(_BatchObjBase):
     def l1_mask(self):
         # L1 only on the linear weights; V and bias are L2-only territory
         m = jnp.zeros(self.num_dim, jnp.float32)
-        return m.at[: self.num_feature].set(1.0)
+        return self.pad_mask(m.at[: self.num_feature].set(1.0))
 
     def predict(self, p, seg, idx, val, num_rows: int):
         return self._margin(p, seg, idx, val, num_rows)

@@ -87,13 +87,45 @@ class GbdtConfig:
 _SKETCH_ROWS = 1 << 17  # quantile-sketch sample cap (approx sketch parity)
 
 
-def _load_rowblocks(pattern: str, fmt: str, num_parts_per_file: int,
-                    minibatch: int) -> RowBlock:
-    blocks = list(iter_rowblocks(pattern, num_parts_per_file, fmt,
-                                 minibatch, node="gbdt-load"))
-    if not blocks:
+def _reservoir_sample(pattern: str, fmt: str, num_parts_per_file: int,
+                      minibatch: int, seed: int,
+                      cap: int = _SKETCH_ROWS):
+    """One streaming pass: reservoir-sample up to `cap` rows (kept as
+    sparse (index, value, label) triples so no dense matrix exists before
+    the feature count is known) and discover the feature dimension — the
+    global approx sketch + Allreduce<Max> dim discovery of xgboost
+    without materializing the dataset."""
+    rng = np.random.default_rng(seed)
+    sample: list = []
+    n_seen = 0
+    max_feat = -1
+    for blk in iter_rowblocks(pattern, num_parts_per_file, fmt,
+                              minibatch, node="gbdt-sketch", seed=seed):
+        if blk.nnz:
+            max_feat = max(max_feat, int(blk.index.max()))
+        vals = blk.values_or_ones()
+        for r in range(blk.size):
+            lo, hi = blk.offset[r], blk.offset[r + 1]
+            row = (blk.index[lo:hi].copy(), vals[lo:hi].copy())
+            if len(sample) < cap:
+                sample.append(row)
+            else:
+                # classic reservoir: keep each new row with prob cap/n
+                j = rng.integers(0, n_seen + 1)
+                if j < cap:
+                    sample[j] = row
+            n_seen += 1
+    if n_seen == 0:
         raise ValueError(f"no rows in {pattern}")
-    return RowBlock.concat(blocks)
+    return sample, n_seen, max_feat
+
+
+def _densify_sample(sample, dim: int) -> np.ndarray:
+    X = np.zeros((len(sample), dim), np.float32)
+    for r, (idx, val) in enumerate(sample):
+        keep = idx < dim
+        X[r, idx[keep].astype(np.int64)] = val[keep]
+    return X
 
 
 def _densify(blk: RowBlock, dim: int) -> np.ndarray:
@@ -184,33 +216,41 @@ class GbdtLearner:
 
     # -- data ---------------------------------------------------------------
     def load_dataset(self, pattern: str, fit_bins: bool = False) -> BinnedDataset:
+        """Stream the dataset into device-resident uint8 bins in bounded
+        host memory: a sketch pass (reservoir sample -> quantile edges,
+        discovering dim by running max — the Allreduce<Max> parity,
+        lbfgs.cc:107-113) followed by a binning pass that densifies one
+        chunk at a time. The full dataset never exists on the host as
+        either CSR or float — only as the uint8 bin matrix it ships to
+        the device as."""
         cfg = self.cfg
-        blk = _load_rowblocks(pattern, cfg.data_format,
-                              cfg.num_parts_per_file, cfg.minibatch)
-        if cfg.dim == 0:
-            # Allreduce<Max> dimension discovery parity (lbfgs.cc:107-113)
-            cfg.dim = int(blk.index.max()) + 1 if blk.nnz else 1
         if fit_bins or self.edges is None:
-            rng = np.random.default_rng(cfg.seed)
-            take = min(blk.size, _SKETCH_ROWS)
-            rows = (np.arange(blk.size) if take == blk.size
-                    else rng.choice(blk.size, take, replace=False))
-            sample = _densify(_take_rows(blk, np.sort(rows)), cfg.dim)
-            self.edges = quantile_edges(sample, cfg.max_bin)
-        # bin in chunks to bound host memory
-        n = blk.size
-        binned = np.empty((n, cfg.dim), np.uint8)
-        step = max(1, cfg.minibatch)
-        for lo in range(0, n, step):
-            sub = blk.slice(lo, min(lo + step, n))
-            binned[lo : lo + sub.size] = bin_matrix(
-                _densify(sub, cfg.dim), self.edges)
+            sample, _, max_feat = _reservoir_sample(
+                pattern, cfg.data_format, cfg.num_parts_per_file,
+                cfg.minibatch, cfg.seed)
+            if cfg.dim == 0:
+                cfg.dim = max(max_feat + 1, 1)
+            self.edges = quantile_edges(_densify_sample(sample, cfg.dim),
+                                        cfg.max_bin)
+            del sample
+        # binning pass: one float chunk at a time
+        chunks, labels = [], []
+        for blk in iter_rowblocks(pattern, cfg.num_parts_per_file,
+                                  cfg.data_format, cfg.minibatch,
+                                  node="gbdt-load"):
+            chunks.append(bin_matrix(_densify(blk, cfg.dim), self.edges))
+            labels.append(blk.label.astype(np.float32))
+        if not chunks:
+            raise ValueError(f"no rows in {pattern}")
+        n = sum(c.shape[0] for c in chunks)
         # pad rows to a multiple of the data axis
         pad = (-n) % self._n_data
         if pad:
-            binned = np.concatenate([binned, np.zeros((pad, cfg.dim), np.uint8)])
+            chunks.append(np.zeros((pad, cfg.dim), np.uint8))
+        binned = np.concatenate(chunks)
+        del chunks
         label = np.zeros(n + pad, np.float32)
-        label[:n] = blk.label
+        label[:n] = np.concatenate(labels)
         mask = np.zeros(n + pad, np.float32)
         mask[:n] = 1.0
         b1 = batch_sharding(self.mesh, 1)
@@ -540,21 +580,3 @@ def _empty_trees(cfg: GbdtConfig) -> dict[str, np.ndarray]:
         "is_split": np.zeros((R, T), np.bool_),
         "leaf_value": np.zeros((R, T), np.float32),
     }
-
-
-def _take_rows(blk: RowBlock, rows: np.ndarray) -> RowBlock:
-    """Gather a sorted row subset of a RowBlock (for the quantile sample)."""
-    lens = np.diff(blk.offset).astype(np.int64)[rows]
-    off = np.zeros(len(rows) + 1, np.int64)
-    np.cumsum(lens, out=off[1:])
-    idx = np.concatenate([
-        np.arange(blk.offset[r], blk.offset[r] + lens[i])
-        for i, r in enumerate(rows)
-    ]) if len(rows) else np.zeros(0, np.int64)
-    return RowBlock(
-        label=blk.label[rows],
-        offset=off,
-        index=blk.index[idx],
-        value=None if blk.value is None else blk.value[idx],
-        weight=None if blk.weight is None else blk.weight[rows],
-    )

@@ -42,6 +42,12 @@ class KmeansConfig:
     model_out: Optional[str] = None
     checkpoint_dir: Optional[str] = None  # per-iter state for resume
     seed: int = 0
+    # assignment kernel: dense ([B, d] densify + two MXU matmuls — best
+    # for small/moderate d like MNIST-784) | sparse (per-nonzero gathers
+    # and scatter-adds, never materializing [B, d] — required for huge
+    # hashed feature spaces, the reference's streaming sparse rows,
+    # kmeans.cc:119-130) | auto (sparse when d > 16384)
+    assign_kernel: str = "auto"
 
 
 def discover_dim(pattern: str, fmt: str = "libsvm",
@@ -69,6 +75,8 @@ class KmeansLearner:
         self.start_iter = 0
 
         k, d, B = cfg.num_clusters, cfg.dim, cfg.minibatch
+        self._use_sparse = cfg.assign_kernel == "sparse" or (
+            cfg.assign_kernel == "auto" and d > 16384)
 
         @jax.jit
         def densify(seg, idx, val, mask):
@@ -95,7 +103,40 @@ class KmeansLearner:
             cost = jnp.sum((1.0 - best) * mask)
             return sums, counts, cost
 
-        self._assign_accumulate = assign_accumulate
+        @jax.jit
+        def assign_accumulate_sparse(C, seg, idx, val, mask):
+            """Same contract without ever building [B, d]: similarities
+            by gathering centroid columns per nonzero and segment-summing
+            per row; accumulation by scatter-adding normalized values
+            into the assigned centroid's row. Work is O(nnz * k), HBM is
+            O(k * d) — the sparse streaming of the reference
+            (kmeans.cc:119-130) for hashed feature spaces where B x d
+            cannot exist."""
+            Cn = C / jnp.maximum(
+                jnp.linalg.norm(C, axis=1, keepdims=True), 1e-12)
+            # row norms from the nonzeros alone
+            sq = jax.ops.segment_sum(val * val, seg, num_segments=B)
+            inv_norm = 1.0 / jnp.maximum(jnp.sqrt(sq), 1e-12)
+            # sim[i, c] = sum_nz val * Cn[c, idx] / ||x_i||
+            contrib = val[:, None] * jnp.take(Cn.T, idx, axis=0)  # [nnz, k]
+            sim = jax.ops.segment_sum(contrib, seg, num_segments=B)
+            sim = sim * inv_norm[:, None]
+            # padding rows (mask 0) must not attract real similarity
+            sim = sim * mask[:, None]
+            assign = jnp.argmax(sim, axis=1)
+            best = jnp.max(sim, axis=1)
+            xhat_nz = val * jnp.take(inv_norm * mask, seg)
+            sums = jnp.zeros((k, d), jnp.float32).at[
+                jnp.take(assign, seg), idx].add(xhat_nz)
+            counts = jax.ops.segment_sum(mask, assign, num_segments=k)
+            cost = jnp.sum((1.0 - best) * mask)
+            return sums, counts, cost
+
+        self._assign_accumulate = (
+            assign_accumulate_sparse if self._use_sparse
+            else assign_accumulate)
+        self._assign_dense = assign_accumulate
+        self._assign_sparse = assign_accumulate_sparse
         self._densify = densify
 
     # -- data plumbing ------------------------------------------------------
@@ -120,11 +161,28 @@ class KmeansLearner:
         cfg = self.cfg
         rng = np.random.default_rng(cfg.seed)
         rows = []
-        for seg, idx, val, mask in self._batches():
-            X = np.asarray(self._densify(seg, idx, val, mask))
-            n_real = int(np.asarray(mask).sum())
-            take = min(cfg.num_clusters * 4, n_real)
-            rows.append(X[rng.choice(n_real, size=take, replace=False)])
+        for b in self._batches():
+            if self._use_sparse:
+                # huge d: densify ONLY the sampled candidate rows on the
+                # host instead of the whole [B, d] batch
+                seg, idx, val, mask = (np.asarray(x) for x in b)
+                n_real = int(mask.sum())
+                take = min(cfg.num_clusters * 4, n_real)
+                pick = rng.choice(n_real, size=take, replace=False)
+                slot = np.full(len(mask), -1, np.int64)
+                slot[pick] = np.arange(take)
+                keep = (slot[seg] >= 0) & (val != 0)
+                X = np.zeros((take, cfg.dim), np.float32)
+                X[slot[seg[keep]], idx[keep].astype(np.int64)] = val[keep]
+                norm = np.maximum(
+                    np.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+                rows.append(X / norm)
+            else:
+                seg, idx, val, mask = b
+                X = np.asarray(self._densify(seg, idx, val, mask))
+                n_real = int(np.asarray(mask).sum())
+                take = min(cfg.num_clusters * 4, n_real)
+                rows.append(X[rng.choice(n_real, size=take, replace=False)])
             if sum(len(r) for r in rows) >= cfg.num_clusters * 8:
                 break
         cand = np.concatenate(rows)

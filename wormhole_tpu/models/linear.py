@@ -73,6 +73,13 @@ class LinearConfig:
     # criteo.conf:21): in the multi-process launch, the max number of
     # minibatches a worker trains between syncs against the server group
     max_delay: int = 16
+    # multi-process dispatch: online (greedy, straggler-reassigning) or
+    # batch (stable n/num_workers assignment per pass); local_data asks
+    # each worker to match train_data against ITS filesystem and report,
+    # giving its parts node affinity (reference data_parallel.h:54-100,
+    # config.proto local_data)
+    dispatch: str = "online"
+    local_data: bool = False
     print_sec: int = 1
     save_iter: int = -1
     load_iter: int = -1
@@ -337,9 +344,11 @@ class LinearLearner:
         self._predict_step_mcoo = predict_step_mcoo
 
         # compacted steps are built lazily once the unique-key capacity
-        # is known (auto mode sizes it from the first batch)
+        # is known (auto mode sizes it from the first batch); the lock
+        # serializes the decide+build against concurrent loader threads
         self._compact_cap: Optional[int] = None
         self._ucoo_steps = None
+        self._compact_lock = __import__("threading").Lock()
         if self._mesh_coo or not self.use_pallas or cfg.compact_cap == 0:
             self._compact_cap = 0
 
@@ -359,10 +368,14 @@ class LinearLearner:
         """Decide (once, from the first batch) whether the unique-key
         compacted path engages and build its jitted steps. Returns the
         compact capacity (0 = dense path)."""
-        if self._compact_cap is None:
-            self._compact_cap = self._decide_compact_cap(idx)
-            if self._compact_cap:
-                self._build_ucoo(self._compact_cap)
+        with self._compact_lock:
+            if self._compact_cap is None:
+                cap = self._decide_compact_cap(idx)
+                if cap:
+                    self._build_ucoo(cap)
+                # publish the cap only after the steps exist, so a racing
+                # reader can never see cap set but steps still None
+                self._compact_cap = cap
         return self._compact_cap
 
     def _decide_compact_cap(self, idx) -> int:

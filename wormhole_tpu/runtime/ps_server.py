@@ -400,9 +400,11 @@ class SyncedStore:
     `max_delay` (the reference's bounded-async knob)."""
 
     def __init__(self, store, client: PSClient, max_delay: int = 16,
-                 fixed_bytes: int = 0, derived: Optional[dict] = None):
+                 fixed_bytes: int = 0, derived: Optional[dict] = None,
+                 perf=None):
         self.store = store
         self.client = client
+        self.perf = perf  # optional utils.perf.Perf: times push/pull ops
         self.max_delay = max(int(max_delay), 1)
         self.fixed_bytes = fixed_bytes
         # non-additive derived-table specs forwarded to the servers (e.g.
@@ -424,10 +426,21 @@ class SyncedStore:
         self._base = pulled
 
     def sync(self) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         cur = self.store.to_numpy()
-        deltas = {k: cur[k] - self._base[k] for k in cur}
+        # derived tables (e.g. FTRL's w) are recomputed server-side from
+        # their additive sources; shipping their deltas would be dead
+        # payload the servers discard
+        deltas = {k: cur[k] - self._base[k] for k in cur
+                  if k not in self.derived}
         self.client.push(deltas, fixed_bytes=self.fixed_bytes)
+        t1 = _time.perf_counter()
         self.pull()
+        if self.perf is not None:
+            self.perf.add("ps_push", t1 - t0)
+            self.perf.add("ps_pull", _time.perf_counter() - t1)
         self._steps = 0
         self.num_syncs += 1
 

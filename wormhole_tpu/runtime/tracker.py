@@ -96,8 +96,10 @@ class Scheduler:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  node_timeout: float = 30.0, straggler: bool = True,
-                 num_servers: int = 0):
+                 num_servers: int = 0, num_workers: int = 0):
         self.pool = WorkloadPool()
+        self.num_workers = num_workers
+        self._collect: "Optional[dict]" = None  # worker-local-data round
         self.progress = Progress()
         self.node_timeout = node_timeout
         self.num_servers = num_servers
@@ -149,11 +151,14 @@ class Scheduler:
             host=host, port=int(port),
             node_timeout=float(os.environ.get("WH_NODE_TIMEOUT", "30")),
             num_servers=env.num_servers,
+            num_workers=env.num_workers,
         )
 
     # -- dispatch round management -----------------------------------------
     def start_round(self, pattern: str, num_parts_per_file: int,
-                    fmt: str, wtype: WorkType, data_pass: int) -> int:
+                    fmt: str, wtype: WorkType, data_pass: int,
+                    local_data: bool = False,
+                    dispatch: str = "online") -> int:
         """Load a pass's file parts into the pool (StartDispatch parity,
         data_parallel.h:93-115). Ordering matters both ways: the epoch is
         bumped BEFORE the pool refills so a worker still polling the old
@@ -167,10 +172,35 @@ class Scheduler:
         with self._lock:
             self._epoch += 1
             self._round = dict(type=int(wtype), data_pass=data_pass)
+            if local_data:
+                # worker-local data (reference data_parallel.h:82,96-100):
+                # workers match the pattern against THEIR filesystems and
+                # report; parts then carry node affinity
+                self._collect = dict(pattern=pattern,
+                                     npp=num_parts_per_file, fmt=fmt,
+                                     reported=set())
+                return 0
+            self._collect = None
         n = self.pool.add(pattern, num_parts_per_file, fmt)
         if n == 0:
             raise FileNotFoundError(f"no files match {pattern}")
+        if dispatch == "batch" and self.num_workers > 0:
+            # stable n/num_workers assignment, unchanged between passes
+            # (reference batch mode, data_parallel.h:54-60)
+            self.pool.assign_stable(
+                [f"worker-{r}" for r in range(self.num_workers)])
         return n
+
+    def _round_finished(self) -> bool:
+        """A worker-local-data round is only over when every expected
+        worker has reported its files AND all reported parts are done —
+        otherwise a fast worker draining its own parts would end the
+        round before a slow worker's files ever entered the pool."""
+        with self._lock:
+            if self._collect is not None and self.num_workers > 0:
+                if len(self._collect["reported"]) < self.num_workers:
+                    return False
+        return self.pool.is_finished()
 
     def wait_round(self, print_sec: float = 1.0, t0: Optional[float] = None,
                    verbose: bool = True) -> Progress:
@@ -179,7 +209,7 @@ class Scheduler:
         t0 = t0 or time.time()
         if verbose:
             print(Progress.header(), flush=True)
-        while not self.pool.is_finished():
+        while not self._round_finished():
             time.sleep(print_sec)
             if verbose:
                 print(self.progress.row(t0), flush=True)
@@ -214,9 +244,16 @@ class Scheduler:
             if req.get("epoch") != self._epoch:
                 # worker is in an older round; tell it to resync
                 return {"wait": True, "epoch": self._epoch}
+            with self._lock:
+                if (self._collect is not None
+                        and node not in self._collect["reported"]):
+                    # worker-local-data round: this node must first match
+                    # the pattern locally and report its files
+                    return {"match": self._collect["pattern"],
+                            "epoch": self._epoch}
             got = self.pool.get(node)
             if got is None:
-                done = self.pool.is_finished()
+                done = self._round_finished()
                 return {"done": done, "wait": not done, "epoch": self._epoch}
             part_id, f = got
             return {
@@ -225,6 +262,16 @@ class Scheduler:
                 "round": self._round,
                 "epoch": self._epoch,
             }
+        if op == "add_local":
+            with self._lock:
+                c = self._collect
+                if c is None or req.get("epoch") != self._epoch:
+                    return {"ok": False}
+                c["reported"].add(node)
+                npp, fmt = c["npp"], c["fmt"]
+            n = self.pool.add_files(req.get("files", []), npp, fmt,
+                                    node=node)
+            return {"ok": True, "num_files": n}
         if op == "finish":
             counted = (req.get("epoch") == self._epoch
                        and self.pool.finish(req["part_id"]))
@@ -281,6 +328,16 @@ class Scheduler:
                 if requeued:
                     print(f"node {n} lost; re-queued {requeued} parts",
                           flush=True)
+                with self._lock:
+                    if (self._collect is not None
+                            and n not in self._collect["reported"]):
+                        # a dead worker will never report its local files;
+                        # count it as reported-empty so the round can end
+                        # (its data is unreachable, like the reference
+                        # losing a node's local disk)
+                        self._collect["reported"].add(n)
+                        print(f"node {n} lost before reporting local "
+                              "files; its data is skipped", flush=True)
 
 
 # ------------------------------------------------------------------ client
@@ -370,6 +427,19 @@ class RemotePool:
             if "part_id" in r:
                 f = File(**r["file"])
                 return r["part_id"], f
+            if "match" in r:
+                # worker-local-data round: match the pattern against THIS
+                # node's filesystem and report (data_parallel.h:96-100,
+                # 143-150)
+                from wormhole_tpu.data.match_file import match_file
+
+                try:
+                    files = match_file(r["match"])
+                except FileNotFoundError:
+                    files = []
+                self.client.call(op="add_local", files=files,
+                                 epoch=self.epoch)
+                continue
             if r.get("done"):
                 return None
             if r.get("epoch", self.epoch) != self.epoch:

@@ -9,13 +9,17 @@ trial (:321-356), and rabit checkpoints make iterations elastic
 (:120,194).
 
 TPU design: one process drives the whole mesh, so "partitioned across
-ranks" becomes sharding the flat weight/history arrays over the devices;
-jnp.vdot on sharded arrays compiles to local partial dots + psum — the
-same math as the reference's Allreduce of the 5n dot-product Gram matrix,
-with XLA inserting the collective. The objective accumulates over
-device-resident data batches sharded on the data axis. Host Python drives
-the outer iteration and the data-dependent line search (a host loop of
-jitted evals, the analog of the reference's rank-coordinated trials).
+ranks" becomes sharding the flat weight/history arrays over all devices
+(models/batch_objectives.py pads num_dim to an even split). Each
+iteration fetches ONE Gram matrix of the [S..., Y..., pg] basis — local
+partial dots + an XLA psum, the same math as the reference's single
+Allreduce<Sum> of the 5n dot-product vector (lbfgs.h:235-252) — then
+runs the two-loop recursion on (2m+1)-sized host vectors and forms the
+direction as one device linear combination. The objective accumulates
+over device-resident data batches sharded on the data axis. Host Python
+drives the outer iteration and the data-dependent line search (a host
+loop of jitted evals, the analog of the reference's rank-coordinated
+trials).
 
 OWL-QN specifics (lbfgs.h:358-407): pseudo-gradient at w=0, direction
 sign-fix against the pseudo-gradient, and orthant projection of each
@@ -111,33 +115,67 @@ class LBFGSSolver:
             m_ = self.obj.l1_mask()
             return jnp.where(keep | (m_ == 0), w_new, 0.0)
 
+        @jax.jit
+        def gram(*vs):
+            """B Bᵀ for the stacked basis [S..., Y..., pg]: every dot
+            product the two-loop recursion needs, in ONE device program /
+            ONE host fetch (the reference's single Allreduce<Sum> of the
+            5n dot-product vector, lbfgs.h:235-252)."""
+            B = jnp.stack(vs)
+            return B @ B.T
+
+        @jax.jit
+        def combine(coef, *vs):
+            return jnp.einsum("i,in->n", coef, jnp.stack(vs))
+
+        self._gram = gram
+        self._combine = combine
         self._full_obj = full_obj
         self._pseudo_gradient = pseudo_gradient
         self._fix_dir_sign = fix_dir_sign
         self._orthant_project = orthant_project
+        # host-sync counter: every device->host scalar/array fetch the
+        # solver makes (the quantity the reference minimizes by batching
+        # dots into one allreduce; tests assert the fused path stays lean)
+        self.host_syncs = 0
 
-    # -- two-loop recursion (lbfgs.h:216-318) --------------------------------
-    def _direction(self, pg: jax.Array) -> jax.Array:
-        q = -pg
-        alphas = []
-        for s, y in zip(reversed(self.S), reversed(self.Y)):
-            rho_i = 1.0 / float(jnp.vdot(y, s))
-            a = rho_i * float(jnp.vdot(s, q))
-            q = q - a * y
-            alphas.append((a, rho_i))
-        if self.S:
-            s, y = self.S[-1], self.Y[-1]
-            gamma = float(jnp.vdot(s, y)) / float(jnp.vdot(y, y))
-            q = q * gamma
-        for (a, rho_i), (s, y) in zip(reversed(alphas),
-                                      zip(self.S, self.Y)):
-            b = rho_i * float(jnp.vdot(y, q))
-            q = q + (a - b) * s
-        return q
+    def _fetch(self, x) -> float:
+        self.host_syncs += 1
+        return float(x)
+
+    # -- two-loop recursion in basis coordinates (lbfgs.h:216-318) ----------
+    def _direction(self, pg: jax.Array):
+        """Returns (d, pg_dot_d_or_None). The search direction is computed
+        vector-free: one Gram matrix of the [S..., Y..., pg] basis comes
+        back to the host (ONE sync per iteration instead of ~4m), the
+        two-loop recursion runs on (2m+1)-sized host vectors, and the
+        result is a single device linear combination of the basis."""
+        if not self.S:
+            return -pg, None
+        k = len(self.S)
+        basis = self.S + self.Y + [pg]
+        G = np.asarray(self._gram(*basis))
+        self.host_syncs += 1
+        coef = np.zeros(2 * k + 1)
+        coef[2 * k] = -1.0  # q = -pg
+        alphas = np.zeros(k)
+        rhos = np.zeros(k)
+        for i in range(k - 1, -1, -1):
+            rhos[i] = 1.0 / G[i, k + i]            # 1 / (s_i . y_i)
+            alphas[i] = rhos[i] * float(G[i] @ coef)   # rho (s_i . q)
+            coef[k + i] -= alphas[i]               # q -= a y_i
+        gamma = G[k - 1, 2 * k - 1] / G[2 * k - 1, 2 * k - 1]
+        coef *= gamma
+        for i in range(k):
+            b = rhos[i] * float(G[k + i] @ coef)   # rho (y_i . q)
+            coef[i] += alphas[i] - b               # q += (a - b) s_i
+        d = self._combine(jnp.asarray(coef, jnp.float32), *basis)
+        # pg . d is free from the same Gram: d = sum coef_i B_i
+        return d, float(G[2 * k] @ coef)
 
     # -- one iteration (UpdateOneIter, lbfgs.h:168-196) ----------------------
     def _eval_full(self, w) -> float:
-        return float(self._full_obj(w, self.obj.eval(w)))
+        return self._fetch(self._full_obj(w, self.obj.eval(w)))
 
     def run(self, verbose: bool = True) -> tuple[jax.Array, float]:
         cfg = self.cfg
@@ -155,17 +193,24 @@ class LBFGSSolver:
 
         while self.iter < cfg.max_iter:
             pg = self._pseudo_gradient(w, g)
-            d = self._fix_dir_sign(self._direction(pg), pg)
+            d_raw, gd_raw = self._direction(pg)
+            d = self._fix_dir_sign(d_raw, pg)
+
             # orthant for this step: sign(w), or -sign(pg) where w == 0
             orthant = jnp.where(w != 0, jnp.sign(w), -jnp.sign(pg))
 
-            # backtracking line search (lbfgs.h:321-356)
-            gd = float(jnp.vdot(pg, d))
+            # backtracking line search (lbfgs.h:321-356). pg.d falls out
+            # of the direction's Gram matrix except when the OWL-QN
+            # sign-fix altered d
+            if cfg.reg_l1 > 0 or gd_raw is None:
+                gd = self._fetch(jnp.vdot(pg, d))
+            else:
+                gd = gd_raw
             if gd >= 0:  # not a descent direction: reset history
                 self.S.clear()
                 self.Y.clear()
                 d = -pg
-                gd = float(jnp.vdot(pg, d))
+                gd = self._fetch(jnp.vdot(pg, d))
             alpha = cfg.alpha0
             w_new, objv_new, ok = w, objv, False
             for _ in range(cfg.max_linesearch):
@@ -183,7 +228,7 @@ class LBFGSSolver:
             g_new = self.obj.grad(w_new)
             s = w_new - w
             y = (g_new + cfg.reg_l2 * w_new) - (g + cfg.reg_l2 * w)
-            if float(jnp.vdot(s, y)) > 1e-10:
+            if self._fetch(jnp.vdot(s, y)) > 1e-10:
                 self.S.append(s)
                 self.Y.append(y)
                 if len(self.S) > cfg.m:
@@ -217,9 +262,11 @@ class LBFGSSolver:
             iter=self.iter,
             objv=np.asarray(self.objv_history, dtype=np.float64),
             S=np.stack([np.asarray(s) for s in self.S])
-            if self.S else np.zeros((0, self.obj.num_dim)),
+            if self.S else np.zeros((0, getattr(self.obj, "num_dim_padded",
+                                                self.obj.num_dim))),
             Y=np.stack([np.asarray(y) for y in self.Y])
-            if self.Y else np.zeros((0, self.obj.num_dim)),
+            if self.Y else np.zeros((0, getattr(self.obj, "num_dim_padded",
+                                                self.obj.num_dim))),
         )
 
     def _try_resume(self):

@@ -28,6 +28,7 @@ from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import WorkloadPool, WorkType
 from wormhole_tpu.utils import checkpoint as ckpt
+from wormhole_tpu.utils.perf import Perf, maybe_trace
 
 
 class MinibatchSolver:
@@ -48,6 +49,9 @@ class MinibatchSolver:
         self.t0 = time.time()
         # early-stop hook: (pass progress, data_pass, type) -> bool
         self.stop_hook: Optional[Callable] = None
+        # per-op perf accounting (reference minibatch_solver.h:246-275 +
+        # difacto async_sgd.h:108-127 style)
+        self.perf = Perf(log=self._log)
 
     @property
     def _ckpt_store(self):
@@ -60,6 +64,12 @@ class MinibatchSolver:
         if cfg.model_in:
             ckpt.load_model(self._ckpt_store, cfg.model_in,
                             cfg.load_iter if cfg.load_iter >= 0 else None)
+        result = {}
+        with maybe_trace("minibatch_solver"):
+            result = self._run_passes(cfg)
+        return result
+
+    def _run_passes(self, cfg) -> dict:
         result = {}
         for dp in range(cfg.max_data_pass):
             tr = self.iterate(cfg.train_data, WorkType.TRAIN, dp)
@@ -138,7 +148,10 @@ class MinibatchSolver:
                         # host-side batch prep (padding + pallas tile-sort)
                         # happens here in the loader thread, overlapped with
                         # the main thread's device steps
-                        if not _put(prepare(blk) if prepare else blk):
+                        if prepare:
+                            with self.perf.timer("prepare"):
+                                blk = prepare(blk)
+                        if not _put(blk):
                             return
                     pool.finish(part_id)
             except BaseException as e:
@@ -158,16 +171,26 @@ class MinibatchSolver:
                 else self.learner.eval_batch)
         done_loaders = 0
         last_print = time.time()
+        n_steps = 0
+        t_step = 0.0
+        t_pass0 = time.perf_counter()
         if self.verbose:
             self._log(f"{mode} pass {data_pass}: {data}")
             self._log(Progress.header())
         try:
             while done_loaders < len(threads):
+                t_w = time.perf_counter()
                 item = q.get()
+                self.perf.add("wait", time.perf_counter() - t_w)
                 if item is _END:
                     done_loaders += 1
                     continue
+                t_s = time.perf_counter()
                 prog.merge(step(item))
+                dt = time.perf_counter() - t_s
+                self.perf.add(f"{mode}_step", dt)
+                t_step += dt
+                n_steps += 1
                 if self.verbose and time.time() - last_print >= cfg.print_sec:
                     self._log(prog.row(self.t0))
                     last_print = time.time()
@@ -179,6 +202,16 @@ class MinibatchSolver:
             raise errors[0]
         if self.verbose:
             self._log(prog.row(self.t0))
+        if n_steps:
+            # FinishMinibatch-style pass summary (minibatch_solver.h:
+            # 246-275): average device-step time and the share of wall
+            # time spent outside compute (I/O + parse + any PS sync)
+            wall = time.perf_counter() - t_pass0
+            overhead = max(0.0, 100.0 * (1.0 - t_step / max(wall, 1e-9)))
+            self._log(
+                f"{mode} pass {data_pass}: {n_steps} minibatches, "
+                f"avg {1e3 * t_step / n_steps:.1f}ms/step, "
+                f"{overhead:.0f}% io/comm overhead")
         return prog
 
     # ------------------------------------------------------------- predict

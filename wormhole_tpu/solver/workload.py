@@ -103,18 +103,45 @@ class WorkloadPool:
 
     # -- filling ------------------------------------------------------------
     def add(self, pattern: str, num_parts_per_file: int, fmt: str = "libsvm",
-            shuffle: bool = False, seed: int = 0) -> int:
-        files = match_file(pattern)
+            shuffle: bool = False, seed: int = 0,
+            node: Optional[str] = None) -> int:
+        return self.add_files(match_file(pattern), num_parts_per_file, fmt,
+                              shuffle, seed, node)
+
+    def add_files(self, files: list, num_parts_per_file: int,
+                  fmt: str = "libsvm", shuffle: bool = False, seed: int = 0,
+                  node: Optional[str] = None) -> int:
+        """Add concrete files. With `node`, the parts get node affinity —
+        only that node may be handed them; a file reported by several
+        nodes accumulates all of them in its capable set (worker-local
+        data, reference workload_pool.h:49-61 Add(id) + :141,155 Get
+        filtering)."""
         with self._lock:
+            existing = {(p["file"].filename, p["file"].part): p
+                        for p in self._parts}
             for f in files:
                 for k in range(num_parts_per_file):
+                    p = existing.get((f, k))
+                    if p is not None:
+                        if node:
+                            p["affinity"].add(node)
+                        continue
                     self._parts.append(
                         dict(file=File(f, fmt, k, num_parts_per_file),
-                             state=0, node=None, t_start=0.0)
+                             state=0, node=None, t_start=0.0,
+                             affinity=({node} if node else set()))
                     )
             if shuffle:
                 random.Random(seed).shuffle(self._parts)
             return len(files)
+
+    def assign_stable(self, nodes: list) -> None:
+        """Batch dispatch mode (reference data_parallel.h:54-60): give
+        every part a single fixed owner, round-robin over `nodes` in part
+        order — the same stable n/num_workers assignment each pass."""
+        with self._lock:
+            for i, p in enumerate(self._parts):
+                p["affinity"] = {nodes[i % len(nodes)]}
 
     def clear(self) -> None:
         with self._lock:
@@ -124,9 +151,13 @@ class WorkloadPool:
 
     # -- dispatch -----------------------------------------------------------
     def get(self, node: str) -> Optional[tuple[int, File]]:
-        """Assign one available part to `node`; None when nothing avail."""
+        """Assign one available part to `node`; None when nothing avail.
+        Parts with a non-empty capable set only go to nodes in it
+        (workload_pool.h:141,155)."""
         with self._lock:
-            avail = [i for i, p in enumerate(self._parts) if p["state"] == 0]
+            avail = [i for i, p in enumerate(self._parts)
+                     if p["state"] == 0
+                     and (not p["affinity"] or node in p["affinity"])]
             if not avail:
                 return None
             i = random.choice(avail)

@@ -1,0 +1,95 @@
+"""Lightweight performance instrumentation.
+
+Parity targets (SURVEY §5 tracing/profiling):
+- the reference worker accumulates per-minibatch compute time and logs
+  the average plus the share of time spent outside compute ("comm
+  overhead") when a workload finishes (minibatch_solver.h:246-275);
+- difacto's server classifies ops (push-count / push-grad / pull) and
+  logs mean latencies every N ops (difacto async_sgd.h:108-127);
+- beyond parity: `maybe_trace` hooks the JAX profiler so a run can emit
+  an XProf trace by setting WORMHOLE_PROFILE_DIR.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Perf:
+    """Per-op-class wall-time accounting (ISGDHandle::Perf parity).
+
+    add(op, sec) accumulates; every `log_every` recorded ops the mean
+    latency per class is logged, mirroring the reference's periodic
+    perf rows. Thread-safe (loader threads record alongside the main
+    thread)."""
+
+    def __init__(self, log: Optional[Callable[[str], None]] = None,
+                 log_every: int = 0):
+        self._sum: dict[str, float] = {}
+        self._cnt: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._log = log
+        self._log_every = log_every
+        self._since_log = 0
+
+    def add(self, op: str, sec: float) -> None:
+        with self._lock:
+            self._sum[op] = self._sum.get(op, 0.0) + sec
+            self._cnt[op] = self._cnt.get(op, 0) + 1
+            self._since_log += 1
+            due = self._log_every and self._since_log >= self._log_every
+            if due:
+                self._since_log = 0
+                line = self._row_locked()
+        if self._log and self._log_every and due:
+            self._log(line)
+
+    @contextlib.contextmanager
+    def timer(self, op: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(op, time.perf_counter() - t0)
+
+    def mean_ms(self, op: str) -> float:
+        with self._lock:
+            c = self._cnt.get(op, 0)
+            return 1e3 * self._sum.get(op, 0.0) / c if c else 0.0
+
+    def total(self, op: str) -> float:
+        with self._lock:
+            return self._sum.get(op, 0.0)
+
+    def count(self, op: str) -> int:
+        with self._lock:
+            return self._cnt.get(op, 0)
+
+    def _row_locked(self) -> str:
+        parts = [f"{op} {1e3 * self._sum[op] / self._cnt[op]:.2f}ms"
+                 f"x{self._cnt[op]}"
+                 for op in sorted(self._sum)]
+        return "perf: " + "  ".join(parts)
+
+    def row(self) -> str:
+        with self._lock:
+            return self._row_locked()
+
+
+@contextlib.contextmanager
+def maybe_trace(label: str = "run"):
+    """Wrap a region in a JAX profiler trace when WORMHOLE_PROFILE_DIR is
+    set; no-op (and no jax import) otherwise."""
+    out = os.environ.get("WORMHOLE_PROFILE_DIR")
+    if not out:
+        yield
+        return
+    import jax
+
+    os.makedirs(out, exist_ok=True)
+    with jax.profiler.trace(out):
+        yield
