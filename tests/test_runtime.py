@@ -249,3 +249,49 @@ def test_local_data_round_respects_affinity(tmp_path):
         assert len(seen[0]) == 3 and len(seen[1]) == 3
     finally:
         sched.stop()
+
+
+def test_drop_node_releases_pins_and_skips_unreachable():
+    """Death of a node must not strand parts: batch-mode pins release to
+    other nodes; capability-only parts (local_data) are skipped so the
+    round still ends."""
+    from wormhole_tpu.solver.workload import WorkloadPool
+
+    pool = WorkloadPool()
+    pool.add_files(["a", "b"], 1)
+    pool.assign_stable(["w0", "w1"])       # a->w0, b->w1 (pins)
+    pool.add_files(["c"], 1, node="w1")    # only w1 can read c
+    released, skipped = pool.drop_node("w1")
+    assert released == 1 and skipped == 1  # b's pin freed; c skipped
+    got = []
+    while (g := pool.get("w0")) is not None:
+        got.append(g[1].filename)
+        pool.finish(g[0])
+    assert sorted(got) == ["a", "b"]       # w0 can now take b
+    assert pool.is_finished()              # c counted done (skipped)
+
+
+def test_local_data_all_empty_raises(tmp_path):
+    """A local_data round where no worker matches any file must raise
+    like the non-local path, not hang."""
+    sched = Scheduler(node_timeout=10, num_workers=1)
+    sched.serve()
+    try:
+        sched.start_round("nowhere/part-.*", 1, "libsvm",
+                          WorkType.TRAIN, 0, local_data=True)
+
+        def worker():
+            c = SchedulerClient(sched.uri, "worker-0")
+            c.register()
+            pool = RemotePool(c, poll=0.02)
+            pool.sync_round()
+            assert pool.get() is None  # empty round ends, no hang
+
+        t = threading.Thread(target=worker)
+        t.start()
+        with pytest.raises(FileNotFoundError):
+            sched.wait_round(print_sec=0.05, verbose=False)
+        t.join(timeout=10)
+        assert not t.is_alive()
+    finally:
+        sched.stop()

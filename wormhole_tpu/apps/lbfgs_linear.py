@@ -55,12 +55,17 @@ def main(argv=None) -> int:
         assert cfg.model_in, "pred task needs model_in"
         if not cfg.model_in.endswith(".npz"):
             cfg.model_in += ".npz"
-        w = np.load(cfg.model_in)["w"]
+        st = np.load(cfg.model_in)
+        w = st["w"]
+        # the saved vector may carry sharding padding past the bias;
+        # num_feature is recorded at save time (old files fall back to
+        # the unpadded len - 1 layout)
+        nf = int(st["num_feature"]) if "num_feature" in st else len(w) - 1
         batches, _ = load_batches(
             cfg.test_data or cfg.data, mesh, cfg.data_format,
             cfg.minibatch, cfg.nnz_per_row, cfg.num_parts_per_file)
-        obj = LinearObjFunction(batches, len(w) - 1, mesh)
-        wp = obj.place(np.asarray(w, np.float32))
+        obj = LinearObjFunction(batches, nf, mesh)
+        wp = obj.place(np.asarray(w[: nf + 1], np.float32))
         n = 0
         with open(cfg.pred_out, "w") as f:
             for seg, idx, val, label, mask in batches:
@@ -83,7 +88,7 @@ def main(argv=None) -> int:
     w, objv = solver.run()
     print(f"final objective: {objv:.6f}")
     if cfg.model_out:
-        np.savez(cfg.model_out, w=np.asarray(w))
+        np.savez(cfg.model_out, w=np.asarray(w), num_feature=num_feature)
         print(f"saved model to {cfg.model_out}")
     return 0
 

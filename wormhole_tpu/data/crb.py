@@ -37,7 +37,9 @@ def _pack_field(arr: Optional[np.ndarray]) -> bytes:
 def write_crb(path: str, blocks, append: bool = False) -> int:
     """Write RowBlocks as CRB records; returns #records written."""
     n = 0
-    with open(path, "ab" if append else "wb") as f:
+    from wormhole_tpu.data import filesys as fsys
+
+    with fsys.open_stream(path, "ab" if append else "wb") as f:
         for blk in blocks:
             rec = [struct.pack("<III", MAGIC, 0, blk.size)]
             rec.append(_pack_field(np.asarray(blk.label, np.float32)))
@@ -94,7 +96,9 @@ def read_crb(path: str, part: int = 0, num_parts: int = 1) -> Iterator[RowBlock]
     """Stream records of (part k of n): records are dealt round-robin to
     parts (disjoint-cover contract of InputSplit); other parts' records are
     seeked over via the length prefixes, not decompressed."""
-    with open(path, "rb") as f:
+    from wormhole_tpu.data import filesys as fsys
+
+    with fsys.open_stream(path, "rb") as f:
         i = 0
         while True:
             if i % num_parts == part:

@@ -1,33 +1,36 @@
 """Regex file matching over a directory, URI-style.
 
-Parity with reference learn/base/match_file.h:12-45: the pattern's directory
-part is listed and the basename is applied as a POSIX regex against entries.
-Works for local paths; GCS-style URIs would plug in here (the reference
-supports hdfs://, s3:// through dmlc-core filesystems).
+Parity with reference learn/base/match_file.h:12-45: the pattern's
+directory part is listed and the basename is applied as a POSIX regex
+against entries. Works uniformly over URI schemes through data/filesys
+(local fully; gs:// when the client library is present; hdfs/s3 via
+register_filesystem) — the reference routes through dmlc-core
+FileSystem::ListDirectory the same way.
 """
 
 from __future__ import annotations
 
-import os
 import re
+
+from wormhole_tpu.data import filesys as fsys
 
 
 def match_file(pattern: str) -> list[str]:
     """Return sorted files whose basename matches the regex ``pattern``'s
     basename, within its directory. A plain existing file matches itself."""
-    if os.path.isfile(pattern):
+    if fsys.isfile(pattern):
         return [pattern]
-    dirname = os.path.dirname(pattern) or "."
-    base = os.path.basename(pattern)
+    dirname = fsys.dirname(pattern) or "."
+    base = fsys.basename(pattern)
     try:
         rx = re.compile(base)
     except re.error as e:
         raise ValueError(f"bad file regex {base!r}: {e}") from None
-    if not os.path.isdir(dirname):
+    if not fsys.isdir(dirname):
         return []
     out = [
-        os.path.join(dirname, name)
-        for name in os.listdir(dirname)
-        if rx.search(name) and os.path.isfile(os.path.join(dirname, name))
+        fsys.join(dirname, name)
+        for name in fsys.list_dir(dirname)
+        if rx.search(name) and fsys.isfile(fsys.join(dirname, name))
     ]
     return sorted(out)

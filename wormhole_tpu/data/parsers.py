@@ -155,13 +155,15 @@ def iter_file_chunks(
     """Yield text chunks of (part k of n) of a file, split on line
     boundaries — the InputSplit contract (dmlc-core InputSplit::Create):
     a part starts at the first line beginning at-or-after its byte range
-    start and ends at the first line boundary at-or-after its range end."""
-    import os
+    start and ends at the first line boundary at-or-after its range end.
+    `path` may be any URI data/filesys.py supports (Stream::Create
+    parity)."""
+    from wormhole_tpu.data import filesys as fsys
 
-    size = os.path.getsize(path)
+    size = fsys.getsize(path)
     begin = size * part // num_parts
     end = size * (part + 1) // num_parts
-    with open(path, "rb") as f:
+    with fsys.open_stream(path, "rb") as f:
         if begin > 0:
             f.seek(begin - 1)
             # consume the partial line belonging to the previous part

@@ -21,6 +21,7 @@ TPU design (vs the reference's worker/server processes):
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Optional
 
@@ -348,7 +349,7 @@ class LinearLearner:
         # serializes the decide+build against concurrent loader threads
         self._compact_cap: Optional[int] = None
         self._ucoo_steps = None
-        self._compact_lock = __import__("threading").Lock()
+        self._compact_lock = threading.Lock()
         if self._mesh_coo or not self.use_pallas or cfg.compact_cap == 0:
             self._compact_cap = 0
 

@@ -195,11 +195,16 @@ class Scheduler:
         """A worker-local-data round is only over when every expected
         worker has reported its files AND all reported parts are done —
         otherwise a fast worker draining its own parts would end the
-        round before a slow worker's files ever entered the pool."""
+        round before a slow worker's files ever entered the pool. A
+        collect round where every worker reported zero files terminates
+        (as an empty round) instead of spinning; wait_round raises the
+        same FileNotFoundError the non-local path does."""
         with self._lock:
             if self._collect is not None and self.num_workers > 0:
                 if len(self._collect["reported"]) < self.num_workers:
                     return False
+                if self.pool.size() == 0:
+                    return True
         return self.pool.is_finished()
 
     def wait_round(self, print_sec: float = 1.0, t0: Optional[float] = None,
@@ -213,6 +218,13 @@ class Scheduler:
             time.sleep(print_sec)
             if verbose:
                 print(self.progress.row(t0), flush=True)
+        with self._lock:
+            empty_collect = (self._collect is not None
+                             and self.pool.size() == 0)
+            pattern = self._collect["pattern"] if empty_collect else None
+        if empty_collect:
+            raise FileNotFoundError(
+                f"no worker matched any file for {pattern!r}")
         if verbose:
             print(self.progress.row(t0), flush=True)
         return self.progress
@@ -328,6 +340,10 @@ class Scheduler:
                 if requeued:
                     print(f"node {n} lost; re-queued {requeued} parts",
                           flush=True)
+                released, skipped = self.pool.drop_node(n)
+                if skipped:
+                    print(f"node {n} lost; {skipped} parts only it could "
+                          "read are skipped", flush=True)
                 with self._lock:
                     if (self._collect is not None
                             and n not in self._collect["reported"]):

@@ -279,6 +279,18 @@ class LBFGSSolver:
         st = np.load(path)
         self.iter = int(st["iter"])
         self.objv_history = list(st["objv"])
-        self.S = [jnp.asarray(s) for s in st["S"]]
-        self.Y = [jnp.asarray(y) for y in st["Y"]]
-        return jnp.asarray(st["w"])
+
+        def restore(v):
+            """Re-place a checkpointed vector under the CURRENT objective:
+            strip any old sharding padding (padding is provably zero) and
+            let place() re-pad and shard for this mesh, so a checkpoint
+            moves between device counts and resumed state keeps the
+            non-replicated sharding."""
+            v = np.asarray(v)[: self.obj.num_dim]
+            place = getattr(self.obj, "place", None)
+            return place(jnp.asarray(v, jnp.float32)) if place else (
+                jnp.asarray(v, jnp.float32))
+
+        self.S = [restore(s) for s in st["S"]]
+        self.Y = [restore(y) for y in st["Y"]]
+        return restore(st["w"])

@@ -129,7 +129,8 @@ class WorkloadPool:
                     self._parts.append(
                         dict(file=File(f, fmt, k, num_parts_per_file),
                              state=0, node=None, t_start=0.0,
-                             affinity=({node} if node else set()))
+                             affinity=({node} if node else set()),
+                             pin=None)
                     )
             if shuffle:
                 random.Random(seed).shuffle(self._parts)
@@ -138,10 +139,12 @@ class WorkloadPool:
     def assign_stable(self, nodes: list) -> None:
         """Batch dispatch mode (reference data_parallel.h:54-60): give
         every part a single fixed owner, round-robin over `nodes` in part
-        order — the same stable n/num_workers assignment each pass."""
+        order — the same stable n/num_workers assignment each pass. Pins
+        are preferences (any node CAN read the data), so a dead owner's
+        pins are cleared by drop_node rather than stranding the parts."""
         with self._lock:
             for i, p in enumerate(self._parts):
-                p["affinity"] = {nodes[i % len(nodes)]}
+                p["pin"] = nodes[i % len(nodes)]
 
     def clear(self) -> None:
         with self._lock:
@@ -157,7 +160,8 @@ class WorkloadPool:
         with self._lock:
             avail = [i for i, p in enumerate(self._parts)
                      if p["state"] == 0
-                     and (not p["affinity"] or node in p["affinity"])]
+                     and (not p["affinity"] or node in p["affinity"])
+                     and (p["pin"] is None or p["pin"] == node)]
             if not avail:
                 return None
             i = random.choice(avail)
@@ -187,6 +191,26 @@ class WorkloadPool:
                     n += 1
         return n
 
+    def drop_node(self, node: str) -> tuple[int, int]:
+        """A node left for good: release its batch-mode pins (anyone can
+        take those parts) and remove it from capability sets; parts ONLY
+        it could read become unreachable and are marked skipped so the
+        round can still end — the reference loses a dead node's local
+        disk the same way. Returns (pins_released, parts_skipped)."""
+        released = skipped = 0
+        with self._lock:
+            for p in self._parts:
+                if p["pin"] == node:
+                    p["pin"] = None
+                    released += 1
+                if node in p["affinity"]:
+                    p["affinity"].discard(node)
+                    if not p["affinity"] and p["state"] != 2:
+                        p.update(state=2, node=None)
+                        skipped += 1
+            self.num_skipped = getattr(self, "num_skipped", 0) + skipped
+        return released, skipped
+
     def is_finished(self) -> bool:
         """An empty pool is NOT finished — it is a pool that has not been
         filled (or was just cleared mid-round-change); callers polling it
@@ -194,6 +218,10 @@ class WorkloadPool:
         with self._lock:
             return bool(self._parts) and all(
                 p["state"] == 2 for p in self._parts)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._parts)
 
     def pending(self) -> int:
         with self._lock:

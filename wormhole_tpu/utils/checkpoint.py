@@ -21,7 +21,25 @@ import numpy as np
 
 def atomic_savez(path: str, compressed: bool = False, **arrays) -> None:
     """np.savez via temp file + os.replace so a crash mid-write can never
-    leave a truncated checkpoint that bricks resume."""
+    leave a truncated checkpoint that bricks resume. Non-local URIs
+    (gs:// etc) upload a complete buffer on close — object stores make
+    whole-object writes atomic by nature (iter_solver.h writes model
+    shards to HDFS/S3 the same way)."""
+    from wormhole_tpu.data import filesys as fsys
+
+    scheme, spath = fsys.split_scheme(path)
+    if scheme == "file":
+        path, scheme = spath, ""  # local branch with the scheme stripped
+    if scheme:
+        if not path.endswith(".npz"):
+            path += ".npz"
+        import io
+
+        buf = io.BytesIO()
+        (np.savez_compressed if compressed else np.savez)(buf, **arrays)
+        with fsys.open_stream(path, "wb") as f:
+            f.write(buf.getvalue())
+        return
     tmp = path + ".tmp"
     (np.savez_compressed if compressed else np.savez)(tmp, **arrays)
     # savez appends .npz to paths without the suffix
@@ -76,7 +94,33 @@ def load_parts(base: str, it: Optional[int] = None) -> dict[str, np.ndarray]:
     """Read a checkpoint written with any shard count — either the plain
     `<base>.npz` single file or `_part-R` files concatenated on the bucket
     axis — into full-model numpy arrays."""
+    from wormhole_tpu.data import filesys as fsys
+
+    scheme, sbase = fsys.split_scheme(base)
+    if scheme == "file":
+        base, scheme = sbase, ""
     prefix = save_prefix(base, it)
+    if scheme:
+        import io
+
+        def load_uri(u):
+            with fsys.open_stream(u, "rb") as f:
+                return dict(np.load(io.BytesIO(f.read())))
+
+        if fsys.isfile(prefix + ".npz"):
+            return load_uri(prefix + ".npz")
+        d, b = fsys.dirname(prefix), fsys.basename(prefix)
+        paths = sorted(
+            (fsys.join(d, n) for n in fsys.list_dir(d)
+             if re.fullmatch(re.escape(b) + r"_part-\d+\.npz", n)),
+            key=lambda p: int(re.search(r"_part-(\d+)\.npz$", p).group(1)),
+        )
+        if not paths:
+            raise FileNotFoundError(
+                f"no checkpoint matches {prefix}.npz or {prefix}_part-*")
+        parts = [load_uri(p) for p in paths]
+        return {k: np.concatenate([p[k] for p in parts], axis=0)
+                for k in parts[0]}
     if os.path.exists(prefix + ".npz"):
         return dict(np.load(prefix + ".npz"))
     paths = sorted(
