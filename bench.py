@@ -165,23 +165,33 @@ def bench_difacto(steps=20):
     )
     lrn = DifactoLearner(cfg, make_mesh(num_data=1, num_model=1))
     rng = np.random.default_rng(1)
+    import types
+
     import jax.numpy as jnp
 
     batches = []
     for _ in range(4):
         seg, idx, val, label, mask = synth_criteo_batch(
             rng, mb, cfg.num_buckets)
-        vidx = (idx % np.int32(cfg.vb)).astype(np.int32)
-        put = lambda x: jax.device_put(jnp.asarray(x), lrn._bsh1)
-        batches.append((put(seg), put(idx), put(vidx), put(val),
-                        put(label), put(mask)))
+        if lrn._use_fm_pallas:
+            db = types.SimpleNamespace(seg=seg, idx=idx, val=val)
+            pk = lrn._pack_fm(db, train=True)
+            args = [jax.device_put(a) for a in
+                    lrn._fm_args(pk, label, mask, train=True)]
+            batches.append(tuple(args))
+        else:
+            vidx = (idx % np.int32(cfg.vb)).astype(np.int32)
+            put = lambda x: jax.device_put(jnp.asarray(x), lrn._bsh1)
+            batches.append((put(seg), put(idx), put(vidx), put(val),
+                            put(label), put(mask)))
+    step = (lrn._fm_steps[0] if lrn._use_fm_pallas else lrn._train_step)
 
     def run_chain(n):
         state, vstate = lrn.store.state, lrn.vstore.state
         prog = None
         for i in range(n):
             lrn._rng, sub = jax.random.split(lrn._rng)
-            state, vstate, prog = lrn._train_step(
+            state, vstate, prog = step(
                 state, vstate, *batches[i % len(batches)], sub)
         float(prog["objv"])
         lrn.store.state, lrn.vstore.state = state, vstate

@@ -29,6 +29,7 @@ TPU design (SURVEY §7.5 two-table plan):
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Optional
 
@@ -38,7 +39,9 @@ import numpy as np
 
 from wormhole_tpu.data.rowblock import DeviceBatch, RowBlock, to_device_batch
 from wormhole_tpu.models import linear as linmod
+from wormhole_tpu.ops import coo_kernels as ck
 from wormhole_tpu.ops import metrics as M
+from wormhole_tpu.ops.localizer import localize
 from wormhole_tpu.ops.penalty import l1l2_solve
 from wormhole_tpu.ops.spmv import row_squares, spmm, spmv, spmv_t
 from wormhole_tpu.parallel.kvstore import KVStore, TableSpec, quantize_push
@@ -108,6 +111,8 @@ class _CombinedStore:
         self.stores = stores
         self.mesh = stores[0].mesh
 
+    on_load = None  # callback fired after from_numpy (count-mirror sync)
+
     def to_numpy(self):
         out = {}
         for s in self.stores:
@@ -121,6 +126,8 @@ class _CombinedStore:
         for s in self.stores:
             own = {k: v for k, v in arrays.items() if k in s.state}
             s.from_numpy(own)
+        if self.on_load is not None:
+            self.on_load()
 
     def nnz(self, name="w"):
         for s in self.stores:
@@ -152,6 +159,21 @@ class DifactoLearner:
         self._dropped_rows = 0
         self._step_count = 0
         self.ckpt_store = _CombinedStore(self.store, self.vstore)
+        # compact Pallas FM path (see the block comment above _pack_fm);
+        # l1_shrk needs device-resident w, sharded meshes use the XLA
+        # collectives path
+        D = self.mesh.shape.get("data", 1)
+        M_ = self.mesh.shape.get("model", 1)
+        self._use_fm_pallas = (
+            cfg.kernel == "pallas"
+            or (cfg.kernel == "auto" and jax.default_backend() == "tpu")
+        ) and (not cfg.l1_shrk and D == 1 and M_ == 1
+               and cfg.minibatch % 128 == 0)
+        self._fm_caps = None
+        self._fm_steps = None
+        self._fm_lock = threading.Lock()
+        self._cnt_host = np.zeros(cfg.num_buckets, np.float32)
+        self.ckpt_store.on_load = self.refresh_count_mirror
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def train_step(state, vstate, seg, idx, vidx, val, label, mask, rngkey):
@@ -244,34 +266,290 @@ class DifactoLearner:
                       "lr_beta": cfg.lr_beta, "lambda_l1": cfg.lambda_l1,
                       "lambda_l2": cfg.lambda_l2}}
 
-    # -- plumbing ----------------------------------------------------------
-    def _batch(self, blk: RowBlock):
+    # -- compact Pallas FM path ---------------------------------------------
+    # The XLA segment-op step spends ~85ms/step at Criteo shape: per-nnz
+    # [nnz, dim] gathers + two segment-sums for the V terms, a 4M-wide
+    # count scatter, and dense table updates. The compact path localizes
+    # both key spaces on the host (the Localizer role), runs the scalar
+    # COO kernels on the compact w domain and the FM/SpMM kernels
+    # (fm_pull/fm_push) on the compact V domain, and updates/scatters
+    # only touched entries. Admission (cnt >= threshold) is computed on a
+    # HOST count mirror during packing — counts are pure data statistics
+    # the host can track exactly, and the mirror resyncs from the store
+    # after loads and PS pulls. l1_shrk needs device-resident w, so it
+    # stays on the XLA path.
+
+    def refresh_count_mirror(self) -> None:
+        self._cnt_host = np.asarray(self.store.state["cnt"]).copy()
+
+    def on_pass_start(self) -> None:
+        """Solver hook: resync the count mirror from the device table so
+        any drift (e.g. batches packed but never consumed after an
+        aborted pass) is bounded to one pass."""
+        with self._fm_lock:
+            self.refresh_count_mirror()
+
+    def _fm_dtype_of(self):
+        cfg = self.cfg
+        if cfg.kernel_dtype == "f32":
+            return jnp.float32
+        if cfg.kernel_dtype == "auto" and cfg.fixed_bytes == 0:
+            return jnp.float32
+        return None  # kernel default (bf16 on TPU, f32 in interpret)
+
+    def _pack_fm(self, db: DeviceBatch, train: bool):
+        """Host pack (loader threads, serialized by _fm_lock so the count
+        mirror sees batches in order): localize w keys and V keys, apply
+        admission to the V values, and lay both out for the kernels."""
+        cfg = self.cfg
+        idx64 = db.idx.astype(np.int64)
+        live = db.val != 0
+        loc = localize(idx64.astype(np.uint64))
+        uniq = loc.uniq_keys.astype(np.int64)
+        slot = loc.local_index
+        live_counts = np.bincount(
+            slot[live], minlength=len(uniq)).astype(np.float32)
+        with self._fm_lock:
+            if self._fm_caps is None:
+                # the first batch to pack may be a short tail part: scale
+                # its unique counts up to a full minibatch's worth (capped
+                # at 4x) so the permanent capacities are not sized from a
+                # fragment
+                fill = cfg.row_capacity / max(int(live.sum()), 1)
+                scale = 1.5 * min(max(fill, 1.0), 4.0)
+                uw = -(-int(scale * len(uniq)) // ck.TILE) * ck.TILE
+                uv_est = (len(np.unique(idx64[live] % cfg.vb))
+                          if live.any() else 1)
+                uv = -(-int(scale * uv_est + 512)
+                       // ck.TILE_HI) * ck.TILE_HI
+                self._fm_caps = (uw, uv)
+                self._build_fm(uw, uv)
+        uw_cap, uv_cap = self._fm_caps
+
+        seg, val = db.seg, db.val
+        dropped = 0
+        if len(uniq) > uw_cap:
+            keep = slot < uw_cap
+            dropped += int(np.count_nonzero(~keep & live))
+            idx64, seg, val, slot = (idx64[keep], seg[keep], val[keep],
+                                     slot[keep])
+            live = val != 0
+            uniq, live_counts = uniq[:uw_cap], live_counts[:uw_cap]
+        out_uniq = np.full(uw_cap, cfg.num_buckets, np.int32)
+        out_uniq[: len(uniq)] = uniq
+        wcnts = np.zeros(uw_cap, np.float32)
+        wcnts[: len(live_counts)] = live_counts
+
+        # admission per key from the mirror; training includes this
+        # batch's own counts (the reference makes the weight pull depend
+        # on the count push of the same minibatch, async_sgd.h:374-381).
+        # Only this mirror read-modify-write needs the lock — packing
+        # itself runs concurrently across loader threads.
+        with self._fm_lock:
+            cnt_key = self._cnt_host[uniq]
+            if train:
+                cnt_key = cnt_key + live_counts[: len(uniq)]
+                self._cnt_host[uniq] += live_counts[: len(uniq)]
+        adm_key = cnt_key >= cfg.threshold
+        adm_nz = adm_key[slot] & live
+
+        wcoo = ck.pack_sorted_coo(slot, seg, val, uw_cap,
+                                  capacity=cfg.row_capacity)
+
+        # V domain: localize (bucket % vb) of admitted nonzeros
+        vidx = (idx64 % cfg.vb).astype(np.uint64)
+        loc_v = localize(vidx)
+        vuniq = loc_v.uniq_keys.astype(np.int64)
+        vslot = loc_v.local_index
+        vval = np.where(adm_nz, val, 0.0).astype(np.float32)
+        if len(vuniq) > uv_cap:
+            keepv = vslot < uv_cap
+            dropped += int(np.count_nonzero(~keepv & (vval != 0)))
+            segv, vvalv, vslotv = seg[keepv], vval[keepv], vslot[keepv]
+            vuniq = vuniq[:uv_cap]
+        else:
+            segv, vvalv, vslotv = seg, vval, vslot
+        out_vuniq = np.full(uv_cap, cfg.vb, np.int32)
+        out_vuniq[: len(vuniq)] = vuniq
+        vtouched = np.zeros(uv_cap, np.float32)
+        tv = np.bincount(vslotv[vvalv != 0],
+                         minlength=len(vuniq)).astype(np.float32)
+        vtouched[: len(tv)] = (tv > 0)
+        vcoo = ck.pack_sorted_coo(vslotv, segv, vvalv, uv_cap,
+                                  capacity=cfg.row_capacity,
+                                  tile=ck.TILE_HI, blk=ck.FM_BLK)
+        if dropped:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fm compaction overflow: dropped %d nonzeros — raise "
+                "the first batch's key diversity (caps %s)",
+                dropped, self._fm_caps)
+        return (out_uniq, wcnts, wcoo, out_vuniq, vtouched, vcoo)
+
+    def _build_fm(self, uw_cap: int, uv_cap: int) -> None:
+        cfg = self.cfg
+        dt = self._fm_dtype_of()
+
+        def forward(wc, Vc, pk_dev):
+            (widx, wseg, wval, wtmap, wfirst,
+             vidx, vseg, vval, vtmap, vfirst) = pk_dev
+            xw = ck.coo_spmv(wc, widx, wseg, wval, wtmap, wfirst,
+                             cfg.minibatch, dtype=dt)
+            xv_img, x2_img = ck.fm_pull(Vc, vidx, vseg, vval, vtmap,
+                                        vfirst, cfg.minibatch, dtype=dt)
+            xv = ck.fm_rows(xv_img)
+            x2 = ck.fm_rows(x2_img)
+            margin = xw + 0.5 * jnp.sum(xv * xv - x2, axis=-1)
+            return xw, xv_img, margin
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def train_fm(state, vstate, uniq_w, wcnts, widx, wseg, wval,
+                     wtmap, wfirst, uniq_v, vtouched, vidx, vseg, vval,
+                     vtmap, vfirst, label, mask, rngkey):
+            zc = jnp.take(state["z"], uniq_w, mode="clip")
+            nc = jnp.take(state["n"], uniq_w, mode="clip")
+            eta = (cfg.lr_beta + jnp.sqrt(nc)) / cfg.lr_eta
+            wc = l1l2_solve(-zc, eta, cfg.lambda_l1, cfg.lambda_l2)
+            Vc = jnp.take(vstate["V"], uniq_v, axis=0, mode="clip")
+            nVc = jnp.take(vstate["nV"], uniq_v, axis=0, mode="clip")
+            pk_dev = (widx, wseg, wval, wtmap, wfirst,
+                      vidx, vseg, vval, vtmap, vfirst)
+            xw, xv_img, margin = forward(wc, Vc, pk_dev)
+            obj, d = linmod._loss_dual(cfg.loss, label, margin)
+            d = d * mask
+
+            gw = ck.coo_spmv_t(d, widx, wseg, wval, wtmap, wfirst,
+                               uw_cap, dtype=dt)
+            gw = quantize_push(gw, cfg.fixed_bytes)
+            lin_new = linmod._update(
+                "ftrl", {"w": wc, "z": zc, "n": nc}, gw, 1.0, cfg)
+
+            gV = ck.fm_push(Vc, d, xv_img, vidx, vseg, vval, vtmap,
+                            vfirst, dtype=dt)
+            if cfg.grad_normalization:
+                gV = gV / jnp.maximum(jnp.sum(mask), 1.0)
+            if cfg.grad_clipping > 0:
+                gV = jnp.clip(gV, -cfg.grad_clipping, cfg.grad_clipping)
+            if cfg.dropout > 0:
+                keep = jax.random.bernoulli(rngkey, 1.0 - cfg.dropout,
+                                            gV.shape)
+                gV = gV * keep
+            gV = quantize_push(gV, cfg.fixed_bytes)
+            tv = vtouched[:, None]
+            nV_new = nVc + tv * gV * gV
+            etaV = (cfg.V_lr_beta + jnp.sqrt(nV_new)) / cfg.V_lr_eta
+            V_new = jnp.where(tv > 0,
+                              Vc - (gV + cfg.lambda_V * Vc) / etaV, Vc)
+
+            new_state = dict(state)
+            new_state["z"] = state["z"].at[uniq_w].set(
+                lin_new["z"], mode="drop")
+            new_state["n"] = state["n"].at[uniq_w].set(
+                lin_new["n"], mode="drop")
+            new_state["w"] = state["w"].at[uniq_w].set(
+                lin_new["w"], mode="drop")
+            # counts are additive: scatter-add avoids gathering cnt at all
+            new_state["cnt"] = state["cnt"].at[uniq_w].add(
+                wcnts, mode="drop")
+            new_vstate = dict(vstate)
+            new_vstate["V"] = vstate["V"].at[uniq_v].set(
+                V_new, mode="drop")
+            new_vstate["nV"] = vstate["nV"].at[uniq_v].set(
+                nV_new, mode="drop")
+            new_w = (jnp.sum(lin_new["w"] != 0)
+                     - jnp.sum(wc != 0)).astype(jnp.float32)
+            prog = linmod._progress(obj, margin, label, mask, new_w)
+            obj_w, _ = linmod._loss_dual(cfg.loss, label, xw)
+            prog["objv_w"] = jnp.sum(obj_w * mask)
+            return new_state, new_vstate, prog
+
+        @jax.jit
+        def fwd_fm(state, vstate, uniq_w, widx, wseg, wval, wtmap,
+                   wfirst, uniq_v, vidx, vseg, vval, vtmap, vfirst,
+                   label, mask):
+            wc = jnp.take(state["w"], uniq_w, mode="clip")
+            Vc = jnp.take(vstate["V"], uniq_v, axis=0, mode="clip")
+            pk_dev = (widx, wseg, wval, wtmap, wfirst,
+                      vidx, vseg, vval, vtmap, vfirst)
+            _, _, margin = forward(wc, Vc, pk_dev)
+            obj, _ = linmod._loss_dual(cfg.loss, label, margin)
+            return margin, linmod._progress(obj, margin, label, mask)
+
+        self._fm_steps = (train_fm, fwd_fm)
+
+    def prepare_batch(self, blk: RowBlock, train: bool = True):
+        """Host-side batch prep for the solver's loader threads."""
         cfg = self.cfg
         db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
                              cfg.num_buckets)
         if db.dropped_rows:
             self._dropped_rows += db.dropped_rows
-        vidx = (db.idx % np.int32(cfg.vb)).astype(np.int32)
+        if not self._use_fm_pallas:
+            return ("xla", db, blk.size)
+        pk = self._pack_fm(db, train)
+        args = tuple(jax.device_put(a) for a in
+                     self._fm_args(pk, db.label, db.row_mask, train))
+        return ("fm", args, blk.size, train)
+
+    def _fm_args(self, pk, label, mask, train: bool):
+        uniq_w, wcnts, wcoo, uniq_v, vtouched, vcoo = pk
+        j = jnp.asarray
+        wparts = [j(wcoo.idx), j(wcoo.seg), j(wcoo.val), j(wcoo.tmap),
+                  j(wcoo.first)]
+        vparts = [j(vcoo.idx), j(vcoo.seg), j(vcoo.val), j(vcoo.tmap),
+                  j(vcoo.first)]
+        if train:
+            return ([j(uniq_w), j(wcnts)] + wparts
+                    + [j(uniq_v), j(vtouched)] + vparts
+                    + [j(label), j(mask)])
+        return ([j(uniq_w)] + wparts + [j(uniq_v)] + vparts
+                + [j(label), j(mask)])
+
+    def _prepared(self, blk, train: bool):
+        if isinstance(blk, RowBlock):
+            return self.prepare_batch(blk, train=train)
+        return blk
+
+    def _xla_args(self, db):
+        vidx = (db.idx % np.int32(self.cfg.vb)).astype(np.int32)
         put = lambda x: jax.device_put(x, self._bsh1)
         return (put(db.seg), put(db.idx), put(vidx), put(db.val),
                 put(db.label), put(db.row_mask))
 
-    def train_batch(self, blk: RowBlock) -> dict:
+    def train_batch(self, blk) -> dict:
+        b = self._prepared(blk, train=True)
         self._rng, sub = jax.random.split(self._rng)
-        self.store.state, self.vstore.state, prog = self._train_step(
-            self.store.state, self.vstore.state, *self._batch(blk), sub)
+        if b[0] == "fm":
+            _, args, _, _ = b
+            self.store.state, self.vstore.state, prog = self._fm_steps[0](
+                self.store.state, self.vstore.state, *args, sub)
+        else:
+            self.store.state, self.vstore.state, prog = self._train_step(
+                self.store.state, self.vstore.state,
+                *self._xla_args(b[1]), sub)
         self._step_count += 1
         return jax.tree_util.tree_map(float, prog)
 
-    def eval_batch(self, blk: RowBlock) -> dict:
-        _, prog = self._fwd(self.store.state, self.vstore.state,
-                            *self._batch(blk))
+    def _fwd_any(self, blk):
+        b = self._prepared(blk, train=False)
+        if b[0] == "fm":
+            _, args, size, _ = b
+            margin, prog = self._fm_steps[1](
+                self.store.state, self.vstore.state, *args)
+        else:
+            size = b[2]
+            margin, prog = self._fwd(self.store.state, self.vstore.state,
+                                     *self._xla_args(b[1]))
+        return margin, prog, size
+
+    def eval_batch(self, blk) -> dict:
+        _, prog, _ = self._fwd_any(blk)
         return jax.tree_util.tree_map(float, prog)
 
-    def predict_batch(self, blk: RowBlock) -> np.ndarray:
-        margin, _ = self._fwd(self.store.state, self.vstore.state,
-                              *self._batch(blk))
-        out = np.asarray(margin)[: blk.size]
+    def predict_batch(self, blk) -> np.ndarray:
+        margin, _, size = self._fwd_any(blk)
+        out = np.asarray(margin)[:size]
         if self.cfg.prob_predict:
             out = 1.0 / (1.0 + np.exp(-out))
         return out

@@ -478,7 +478,7 @@ class LinearLearner:
             )
         return db
 
-    def prepare_batch(self, blk: RowBlock):
+    def prepare_batch(self, blk: RowBlock, train: bool = True):
         """Host-side batch prep (runs in loader threads): pad to the fixed
         device shape, and for the pallas path additionally tile-sort the
         COO triples (the Localizer role). Returns an opaque prepared batch

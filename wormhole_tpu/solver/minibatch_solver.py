@@ -99,6 +99,9 @@ class MinibatchSolver:
     # ------------------------------------------------------------- iterate
     def iterate(self, data: str, wtype: WorkType, data_pass: int = 0) -> Progress:
         cfg = self.cfg
+        hook = getattr(self.learner, "on_pass_start", None)
+        if hook:
+            hook()
         pool = WorkloadPool()
         nfiles = pool.add(data, cfg.num_parts_per_file, cfg.data_format)
         if nfiles == 0:
@@ -150,7 +153,8 @@ class MinibatchSolver:
                         # the main thread's device steps
                         if prepare:
                             with self.perf.timer("prepare"):
-                                blk = prepare(blk)
+                                blk = prepare(
+                                    blk, train=(wtype == WorkType.TRAIN))
                         if not _put(blk):
                             return
                     pool.finish(part_id)
