@@ -30,7 +30,11 @@ import numpy as np
 
 BASELINE_EXAMPLES_PER_SEC = 2.0e6  # criteo_kaggle.rst tutorial log
 
-MINIBATCH = 1 << 14      # 16384 examples per step (headline config)
+# 64k examples per device step: the large synchronous device batches of
+# the TPU design (SURVEY §7 "async PS semantics"); the reference's own
+# Criteo-1TB operating point uses minibatch=100000
+# (learn/difacto/guide/criteo.conf). Throughput plateaus here on v5e.
+MINIBATCH = 1 << 16
 NUM_BUCKETS = 1 << 22    # 4M hashed buckets (headline config)
 WARMUP_STEPS = 5
 BENCH_STEPS = 60
@@ -152,7 +156,7 @@ def bench_difacto(steps=20):
     from wormhole_tpu.models.difacto import DifactoConfig, DifactoLearner
     from wormhole_tpu.parallel.mesh import make_mesh
 
-    mb = 1 << 14
+    mb = 1 << 16
     cfg = DifactoConfig(
         minibatch=mb,
         num_buckets=1 << 22,
