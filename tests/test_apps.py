@@ -204,3 +204,61 @@ max_delay = 1
     s2.run()  # loads model_in
     val = s2.iterate(cfg2.val_data, WorkType.VAL)
     assert abs(val.mean("logloss") - dist_logloss) < 0.05
+
+
+def test_distributed_difacto_launch(train_files, tmp_path):
+    """DiFacto through the full multi-process PS data plane: both table
+    groups (w/z/n/cnt and V/nV) synchronize through the server group,
+    with w re-derived server-side from merged (z, n). The saved shared
+    model must score like a single-process run."""
+    import re
+
+    conf_text = f"""
+train_data = "{train_files}/train-.*"
+val_data = "{train_files}/val.libsvm"
+model_out = {tmp_path}/fm_model
+algo = ftrl
+dim = 4
+threshold = 2
+lambda_l1 = 0.5
+minibatch = 256
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = 2
+max_delay = 1
+"""
+    conf = tmp_path / "fm.conf"
+    conf.write_text(conf_text)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "2", "-s", "2", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.difacto", str(conf)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = re.search(r"final val: logloss=([0-9.]+)", r.stdout)
+    assert m, r.stdout
+    dist_logloss = float(m.group(1))
+
+    from wormhole_tpu.models.difacto import DifactoConfig, DifactoLearner
+    from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+
+    cfg = DifactoConfig(
+        train_data=f"{train_files}/train-.*",
+        val_data=f"{train_files}/val.libsvm",
+        algo="ftrl", dim=4, threshold=2, lambda_l1=0.5, minibatch=256,
+        num_buckets=16384, v_buckets=4096, max_data_pass=2)
+    res = MinibatchSolver(DifactoLearner(cfg), cfg, verbose=False).run()
+    single_logloss = res["val"].mean("logloss")
+    assert abs(dist_logloss - single_logloss) < 0.05, (
+        dist_logloss, single_logloss, r.stdout)
+
+    # ONE shared model saved as the server group's shard files, carrying
+    # BOTH table groups, reassembling under any shard count
+    from wormhole_tpu.utils.checkpoint import load_parts
+
+    saved = load_parts(f"{tmp_path}/fm_model")
+    for k in ("w", "z", "n", "cnt", "V", "nV"):
+        assert k in saved, sorted(saved)
+    assert saved["V"].shape == (4096, 4)
+    assert saved["w"].shape == (16384,)
