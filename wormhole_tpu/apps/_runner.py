@@ -55,11 +55,205 @@ def run_minibatch_app(cfg, make_learner, verbose: bool = True) -> dict:
     if env.role is None:
         learner = make_learner(cfg, env)
         return MinibatchSolver(learner, cfg, verbose=verbose).run()
+    if getattr(cfg, "global_mesh", False):
+        # one SPMD program over every worker's devices (parallel/multihost)
+        if env.role.value == "scheduler":
+            return _run_scheduler_global(env)
+        if env.role.value == "server":
+            return {}  # no PS data plane: collectives carry the model
+        return _run_worker_global(cfg, env, make_learner, verbose)
     if env.role.value == "scheduler":
         return _run_scheduler(cfg, env, verbose)
     if env.role.value == "server":
         return _run_server(cfg, env)
     return _run_worker(cfg, env, make_learner, verbose)
+
+
+def _run_scheduler_global(env) -> dict:
+    """Global-mesh mode scheduler: pure liveness — the SPMD collectives
+    synchronize the workers, so the control plane only keeps the launcher
+    happy and reports worker deaths. Exits with an error if no worker
+    ever shows up (e.g. the jax.distributed rendezvous failed)."""
+    sched = Scheduler.from_env(env)
+    sched.serve()
+    startup_deadline = time.monotonic() + max(60.0, sched.node_timeout * 4)
+    try:
+        seen_any = False
+        while True:
+            time.sleep(1.0)
+            with sched._lock:
+                workers = [n for n in sched._nodes if n.startswith("worker")]
+            seen_any = seen_any or bool(workers)
+            if seen_any and not workers:
+                return {}
+            if not seen_any and time.monotonic() > startup_deadline:
+                raise RuntimeError(
+                    "no worker registered within the startup deadline — "
+                    "the jax.distributed rendezvous likely failed")
+    finally:
+        sched.stop()
+
+
+def _run_worker_global(cfg, env, make_learner, verbose: bool) -> dict:
+    """Lockstep SPMD worker: all `-n` processes form ONE mesh and run the
+    SAME jitted steps; each contributes minibatch/num_workers rows per
+    step from its stable slice of file parts (the reference's
+    RowBlockIter(rank, world) split, kmeans.cc:149-154). End-of-pass is a
+    collective fact: a step whose global example count is zero means all
+    ranks drained."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from wormhole_tpu.data.match_file import match_file
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
+    from wormhole_tpu.parallel import multihost as mh
+    from wormhole_tpu.parallel.mesh import batch_sharding
+
+    if getattr(cfg, "predict_out", None):
+        raise NotImplementedError(
+            "predict_out is not supported in global_mesh mode yet; run "
+            "predict single-process on the saved model")
+    # register with the control plane BEFORE the blocking jax.distributed
+    # rendezvous so the scheduler can observe a half-formed cluster
+    rank0 = env.rank
+    client = SchedulerClient(env.scheduler_uri, f"worker-{rank0}")
+    client.register()
+    assert mh.init_from_env(env), "global_mesh needs WH_COORD_URI"
+    nproc = env.num_workers
+    assert cfg.minibatch % nproc == 0, (
+        f"minibatch {cfg.minibatch} must divide over {nproc} workers")
+    local_rows = cfg.minibatch // nproc
+    # the SPMD xla path: the pallas packs are per-process host products
+    cfg = _dc.replace(cfg, kernel="xla")
+    learner = make_learner(cfg, env)  # make_mesh() sees GLOBAL devices
+    mesh = learner.mesh
+    assert mesh.devices.size == len(__import__("jax").devices()), (
+        "global-mesh mode expects the learner on the full device set")
+    bsh = batch_sharding(mesh, 1)
+    local_cap = local_rows * cfg.nnz_per_row
+    rank = env.rank
+
+    import threading
+
+    stop_ping = threading.Event()
+
+    def pinger():
+        while not stop_ping.wait(2.0):
+            try:
+                client.call(op="epoch")
+            except Exception:
+                pass
+
+    t = threading.Thread(target=pinger, daemon=True)
+    t.start()
+
+    def my_parts(pattern):
+        files = match_file(pattern)
+        if not files:
+            raise FileNotFoundError(f"no files match {pattern}")
+        parts = [(f, k) for f in files
+                 for k in range(cfg.num_parts_per_file)]
+        return parts[rank::nproc]
+
+    empty = RowBlock(label=np.zeros(0, np.float32),
+                     offset=np.zeros(1, np.int64),
+                     index=np.zeros(0, np.uint64), value=None, weight=None)
+
+    def global_args(blk):
+        db = to_device_batch(blk, local_rows, local_cap, cfg.num_buckets)
+        seg = db.seg + np.int32(rank * local_rows)
+        return (mh.global_batch(bsh, seg, cfg.row_capacity),
+                mh.global_batch(bsh, db.idx, cfg.row_capacity),
+                mh.global_batch(bsh, db.val, cfg.row_capacity),
+                mh.global_batch(bsh, db.label, cfg.minibatch),
+                mh.global_batch(bsh, db.row_mask, cfg.minibatch))
+
+    def run_pass(pattern, train: bool, seed: int):
+        prog_tot: dict = {}
+
+        def batches():
+            for f, k in my_parts(pattern):
+                yield from MinibatchIter(
+                    f, k, cfg.num_parts_per_file, cfg.data_format,
+                    minibatch_size=local_rows,
+                    shuf_buf=(cfg.rand_shuffle * local_rows
+                              if train else 0),
+                    neg_sampling=(cfg.neg_sampling if train else 1.0),
+                    seed=seed)
+
+        it = batches()
+        while True:
+            blk = next(it, None)
+            args = global_args(blk if blk is not None else empty)
+            if train:
+                learner.store.state, prog = learner._train_step(
+                    learner.store.state, *args)
+            else:
+                prog = learner._eval_step(learner.store.state, *args)
+            prog = {k: float(v) for k, v in prog.items()}
+            # nex is a GLOBAL sum (the batch mask is mesh-sharded): zero
+            # means every rank drained. The decision must be THE SAME on
+            # every rank (the next step is a collective), so it depends
+            # only on this global value — never on local state.
+            if prog["nex"] == 0:
+                break
+            for k, v in prog.items():
+                prog_tot[k] = prog_tot.get(k, 0.0) + v
+        return prog_tot
+
+    result = {}
+    try:
+        if cfg.model_in:
+            arrays = ckpt.load_parts(
+                cfg.model_in, cfg.load_iter if cfg.load_iter >= 0 else None)
+            mh.load_replicated(_store(learner), arrays)
+        for dp in range(cfg.max_data_pass):
+            tr = run_pass(cfg.train_data, True, dp)
+            result["train"] = tr
+            if rank == 0 and verbose:
+                n = max(tr.get("nex", 0.0), 1.0)
+                print(f"[global-mesh] train pass {dp}: "
+                      f"nex={int(tr.get('nex', 0.0))} "
+                      f"logloss={tr.get('logloss', 0.0) / n:.6f}",
+                      flush=True)
+            if cfg.val_data:
+                vl = run_pass(cfg.val_data, False, dp)
+                result["val"] = vl
+                if rank == 0 and verbose:
+                    n = max(vl.get("nex", 0.0), 1.0)
+                    print(f"[global-mesh] val pass {dp}: "
+                          f"logloss={vl.get('logloss', 0.0) / n:.6f}",
+                          flush=True)
+        if "val" in result and rank == 0 and verbose:
+            vl = result["val"]
+            n = max(vl.get("nex", 0.0), 1.0)
+            print(f"final val: logloss={vl.get('logloss', 0.0) / n:.6f} "
+                  f"auc={vl.get('auc', 0.0) / n:.6f} "
+                  f"acc={vl.get('acc', 0.0) / n:.6f}", flush=True)
+        if cfg.model_out and rank == 0:
+            # tables are replicated over the global mesh (model axis 1):
+            # fetch each process-locally and save single-file
+            class _GlobalView:
+                mesh = learner.mesh
+
+                @staticmethod
+                def to_numpy():
+                    return {k: mh.fetch_replicated(v)
+                            for k, v in _store(learner).state.items()}
+
+            ckpt.save_model(_GlobalView, cfg.model_out)
+            if verbose:
+                print(f"model saved: {cfg.model_out}", flush=True)
+    finally:
+        stop_ping.set()
+        t.join(timeout=5)  # no in-flight ping may land after the bye
+        try:
+            client.call(op="bye")
+        except Exception:
+            pass
+    return result
 
 
 def _run_scheduler(cfg, env, verbose: bool) -> dict:

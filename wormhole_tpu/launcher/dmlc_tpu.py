@@ -56,6 +56,9 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     tracker's process-group teardown)."""
     port = _free_port()
     uri = f"127.0.0.1:{port}"
+    # jax.distributed rendezvous for apps that opt into the global-mesh
+    # mode (parallel/multihost.py); worker 0 binds it on first use
+    coord_uri = f"127.0.0.1:{_free_port()}"
 
     def spawn(role: str, rank: int) -> subprocess.Popen:
         env = dict(os.environ)
@@ -65,6 +68,7 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             WH_NUM_WORKERS=str(num_workers),
             WH_NUM_SERVERS=str(num_servers),
             WH_SCHEDULER_URI=uri,
+            WH_COORD_URI=coord_uri,
             WH_NODE_TIMEOUT=str(node_timeout),
         )
         if env_extra:

@@ -81,6 +81,10 @@ class LinearConfig:
     # config.proto local_data)
     dispatch: str = "online"
     local_data: bool = False
+    # global-mesh mode: the -n worker processes jax.distributed-initialize
+    # into ONE SPMD mesh; gradients aggregate over ICI/DCN collectives
+    # instead of the TCP parameter server (parallel/multihost.py)
+    global_mesh: bool = False
     print_sec: int = 1
     save_iter: int = -1
     load_iter: int = -1

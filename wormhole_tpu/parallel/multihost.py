@@ -1,0 +1,70 @@
+"""Multi-host global mesh: one SPMD program over every chip of a pod.
+
+The PS data plane (runtime/ps_server.py) shares a model across worker
+processes through TCP push/pull — the reference's ps-lite architecture.
+This module is the OTHER, TPU-native composition (BASELINE.json north
+star): the `-n` worker processes call `jax.distributed.initialize` and
+form ONE global `jax.sharding.Mesh` over all their devices, so the
+jitted train step is a single SPMD program and gradient aggregation
+rides ICI/DCN collectives instead of the TCP parameter server —
+`rabit::Allreduce` become XLA `psum`s the compiler inserts.
+
+Every process runs the SAME jitted steps in lockstep (SPMD requires
+it); each contributes its local rows of every global batch via
+`jax.make_array_from_process_local_data`. The workload split is the
+stable rank slice of file parts (the reference's batch dispatch /
+RowBlockIter(rank, world) pattern, kmeans.cc:149-154) and the
+end-of-pass decision is itself a collective: a step whose GLOBAL
+example count is zero means every rank has drained (see
+apps/_runner._run_worker_global).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def init_from_env(env) -> bool:
+    """Join the jax.distributed cluster the launcher described
+    (WH_COORD_URI; workers only). Idempotent; returns True if this
+    process is part of a multi-process cluster."""
+    import jax
+
+    if not getattr(env, "coord_uri", ""):
+        return False
+    if env.num_workers <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=env.coord_uri,
+        num_processes=env.num_workers,
+        process_id=env.rank,
+    )
+    return True
+
+
+def global_batch(sharding, local_np: np.ndarray, global_rows: int):
+    """Assemble a global device array from this process's local rows
+    (rank-ordered concatenation along axis 0)."""
+    import jax
+
+    shape = (global_rows, *local_np.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_np), global_shape=shape)
+
+
+def load_replicated(store, arrays: dict) -> None:
+    """Install host arrays into a store whose tables are replicated over
+    a multi-process mesh (every process supplies the full array)."""
+    import jax
+
+    for k, v in arrays.items():
+        assert k in store.state, f"unknown table {k}"
+        sh = store.sharding(k)
+        store.state[k] = jax.make_array_from_process_local_data(
+            sh, np.ascontiguousarray(v), global_shape=v.shape)
+
+
+def fetch_replicated(arr) -> np.ndarray:
+    """Host copy of a fully-replicated global array (every process holds
+    a complete shard set, so this is purely local)."""
+    return np.asarray(arr.addressable_data(0))

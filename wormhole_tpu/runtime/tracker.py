@@ -54,6 +54,7 @@ class NodeEnv:
     num_workers: int
     num_servers: int
     scheduler_uri: str
+    coord_uri: str = ""  # jax.distributed coordinator (global-mesh mode)
 
     @property
     def is_distributed(self) -> bool:
@@ -68,6 +69,7 @@ def node_env() -> NodeEnv:
         num_workers=int(os.environ.get("WH_NUM_WORKERS", "1")),
         num_servers=int(os.environ.get("WH_NUM_SERVERS", "1")),
         scheduler_uri=os.environ.get("WH_SCHEDULER_URI", ""),
+        coord_uri=os.environ.get("WH_COORD_URI", ""),
     )
 
 
@@ -298,6 +300,12 @@ class Scheduler:
         if op == "report":  # pure progress push (ps::Slave channel)
             with self._lock:
                 self.progress.merge(req.get("progress", {}))
+            return {"ok": True}
+        if op == "bye":
+            # explicit deregistration (global-mesh workers) so liveness
+            # does not have to time the node out
+            with self._lock:
+                self._nodes.pop(node, None)
             return {"ok": True}
         if op == "epoch":
             return {"epoch": self._epoch,
