@@ -329,3 +329,91 @@ global_mesh = 1
     assert m2, r2.stdout
     assert float(m2.group(1)) <= gm_logloss + 0.02, (
         float(m2.group(1)), gm_logloss)
+
+
+def test_global_mesh_kmeans_launch(tmp_path):
+    """BSP k-means over the multi-process global mesh: the per-iteration
+    (k x d) statistics reduce across 2 processes x 4 devices (the
+    reference's rabit::Allreduce world, kmeans.cc:190); the converged
+    cost matches a single-process run."""
+    import re
+
+    rng_txt = synth_libsvm_text(n_rows=600, n_feat=60, nnz_per_row=8,
+                                seed=31)
+    for i in range(2):
+        (tmp_path / f"km-{i}.libsvm").write_text(
+            synth_libsvm_text(n_rows=300, n_feat=60, nnz_per_row=8,
+                              seed=40 + i))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "2", "-s", "0", "--node-timeout", "10", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.kmeans",
+         f"data={tmp_path}/km-.*", "num_clusters=4", "max_iter=4",
+         "minibatch=256", "global_mesh=1",
+         f"model_out={tmp_path}/centroids.txt"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = re.search(r"final cosine objective: ([0-9.]+)", r.stdout)
+    assert m, r.stdout
+    gm_cost = float(m.group(1))
+    assert os.path.exists(f"{tmp_path}/centroids.txt")
+    assert len(open(f"{tmp_path}/centroids.txt").readlines()) == 4
+
+    from wormhole_tpu.models.kmeans import KmeansConfig, KmeansLearner
+
+    cfg = KmeansConfig(train_data=f"{tmp_path}/km-.*", num_clusters=4,
+                       max_iter=4, minibatch=256, seed=0)
+    single_cost = KmeansLearner(cfg).run(verbose=False)
+    assert abs(gm_cost - single_cost) < 0.1, (gm_cost, single_cost)
+    assert gm_cost < 0.9  # clusters actually found
+
+
+def test_global_mesh_lbfgs_launch(tmp_path):
+    """Distributed L-BFGS over the multi-process global mesh: the weight
+    vector and history basis shard over 2 processes x 4 devices, the
+    Gram reduction and line-search evals ride cross-process collectives
+    (the reference's rabit allreduces, lbfgs.h:172,252), and the final
+    objective matches a single-process run."""
+    import re
+
+    for i in range(2):
+        (tmp_path / f"lb-{i}.libsvm").write_text(
+            synth_libsvm_text(n_rows=400, n_feat=120, nnz_per_row=10,
+                              seed=50 + i))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "2", "-s", "0", "--node-timeout", "10", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.lbfgs_linear",
+         f"data={tmp_path}/lb-.*", "max_lbfgs_iter=15", "reg_L2=0.001",
+         "minibatch=512", "global_mesh=1",
+         f"model_out={tmp_path}/lb_model"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = re.search(r"final objective: ([0-9.]+)", r.stdout)
+    assert m, r.stdout
+    gm_obj = float(m.group(1))
+
+    from wormhole_tpu.models.batch_objectives import (
+        LinearObjFunction, load_batches,
+    )
+    from wormhole_tpu.parallel.mesh import make_mesh
+    from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
+
+    mesh = make_mesh(1, 1)
+    batches, nf = load_batches(f"{tmp_path}/lb-.*", mesh, minibatch=512,
+                               nnz_per_row=64)
+    obj = LinearObjFunction(batches, nf, mesh)
+    _, single_obj = LBFGSSolver(obj, LBFGSConfig(
+        max_iter=15, reg_l2=0.001)).run(verbose=False)
+    # both minimize the same convex objective over the same 800 rows
+    assert abs(gm_obj - single_obj) / max(single_obj, 1.0) < 0.05, (
+        gm_obj, single_obj)
+
+    import numpy as np
+
+    saved = np.load(f"{tmp_path}/lb_model.npz")
+    assert int(saved["num_feature"]) == nf

@@ -101,26 +101,24 @@ def _run_worker_global(cfg, env, make_learner, verbose: bool) -> dict:
     RowBlockIter(rank, world) split, kmeans.cc:149-154). End-of-pass is a
     collective fact: a step whose global example count is zero means all
     ranks drained."""
-    import dataclasses as _dc
-
-    import numpy as np
-
-    from wormhole_tpu.data.match_file import match_file
-    from wormhole_tpu.data.minibatch import MinibatchIter
-    from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
     from wormhole_tpu.parallel import multihost as mh
-    from wormhole_tpu.parallel.mesh import batch_sharding
 
     if getattr(cfg, "predict_out", None):
         raise NotImplementedError(
             "predict_out is not supported in global_mesh mode yet; run "
             "predict single-process on the saved model")
-    # register with the control plane BEFORE the blocking jax.distributed
-    # rendezvous so the scheduler can observe a half-formed cluster
-    rank0 = env.rank
-    client = SchedulerClient(env.scheduler_uri, f"worker-{rank0}")
-    client.register()
-    assert mh.init_from_env(env), "global_mesh needs WH_COORD_URI"
+    with mh.worker_session(env) as client:
+        return _global_train(cfg, env, make_learner, verbose, client)
+
+
+def _global_train(cfg, env, make_learner, verbose, client) -> dict:
+    import dataclasses as _dc
+
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.data.rowblock import to_device_batch
+    from wormhole_tpu.parallel import multihost as mh
+    from wormhole_tpu.parallel.mesh import batch_sharding
+
     nproc = env.num_workers
     assert cfg.minibatch % nproc == 0, (
         f"minibatch {cfg.minibatch} must divide over {nproc} workers")
@@ -134,47 +132,19 @@ def _run_worker_global(cfg, env, make_learner, verbose: bool) -> dict:
     bsh = batch_sharding(mesh, 1)
     local_cap = local_rows * cfg.nnz_per_row
     rank = env.rank
-
-    import threading
-
-    stop_ping = threading.Event()
-
-    def pinger():
-        while not stop_ping.wait(2.0):
-            try:
-                client.call(op="epoch")
-            except Exception:
-                pass
-
-    t = threading.Thread(target=pinger, daemon=True)
-    t.start()
-
-    def my_parts(pattern):
-        files = match_file(pattern)
-        if not files:
-            raise FileNotFoundError(f"no files match {pattern}")
-        parts = [(f, k) for f in files
-                 for k in range(cfg.num_parts_per_file)]
-        return parts[rank::nproc]
-
-    empty = RowBlock(label=np.zeros(0, np.float32),
-                     offset=np.zeros(1, np.int64),
-                     index=np.zeros(0, np.uint64), value=None, weight=None)
+    empty = mh.empty_rowblock()
 
     def global_args(blk):
         db = to_device_batch(blk, local_rows, local_cap, cfg.num_buckets)
-        seg = db.seg + np.int32(rank * local_rows)
-        return (mh.global_batch(bsh, seg, cfg.row_capacity),
-                mh.global_batch(bsh, db.idx, cfg.row_capacity),
-                mh.global_batch(bsh, db.val, cfg.row_capacity),
-                mh.global_batch(bsh, db.label, cfg.minibatch),
-                mh.global_batch(bsh, db.row_mask, cfg.minibatch))
+        return mh.global_coo_batch(bsh, db, rank, local_rows,
+                                   cfg.minibatch, cfg.nnz_per_row)
 
     def run_pass(pattern, train: bool, seed: int):
         prog_tot: dict = {}
 
         def batches():
-            for f, k in my_parts(pattern):
+            for f, k in mh.rank_parts(pattern, cfg.num_parts_per_file,
+                                      env):
                 yield from MinibatchIter(
                     f, k, cfg.num_parts_per_file, cfg.data_format,
                     minibatch_size=local_rows,
@@ -204,55 +174,47 @@ def _run_worker_global(cfg, env, make_learner, verbose: bool) -> dict:
         return prog_tot
 
     result = {}
-    try:
-        if cfg.model_in:
-            arrays = ckpt.load_parts(
-                cfg.model_in, cfg.load_iter if cfg.load_iter >= 0 else None)
-            mh.load_replicated(_store(learner), arrays)
-        for dp in range(cfg.max_data_pass):
-            tr = run_pass(cfg.train_data, True, dp)
-            result["train"] = tr
+    if cfg.model_in:
+        arrays = ckpt.load_parts(
+            cfg.model_in, cfg.load_iter if cfg.load_iter >= 0 else None)
+        mh.load_replicated(_store(learner), arrays)
+    for dp in range(cfg.max_data_pass):
+        tr = run_pass(cfg.train_data, True, dp)
+        result["train"] = tr
+        if rank == 0 and verbose:
+            n = max(tr.get("nex", 0.0), 1.0)
+            print(f"[global-mesh] train pass {dp}: "
+                  f"nex={int(tr.get('nex', 0.0))} "
+                  f"logloss={tr.get('logloss', 0.0) / n:.6f}",
+                  flush=True)
+        if cfg.val_data:
+            vl = run_pass(cfg.val_data, False, dp)
+            result["val"] = vl
             if rank == 0 and verbose:
-                n = max(tr.get("nex", 0.0), 1.0)
-                print(f"[global-mesh] train pass {dp}: "
-                      f"nex={int(tr.get('nex', 0.0))} "
-                      f"logloss={tr.get('logloss', 0.0) / n:.6f}",
+                n = max(vl.get("nex", 0.0), 1.0)
+                print(f"[global-mesh] val pass {dp}: "
+                      f"logloss={vl.get('logloss', 0.0) / n:.6f}",
                       flush=True)
-            if cfg.val_data:
-                vl = run_pass(cfg.val_data, False, dp)
-                result["val"] = vl
-                if rank == 0 and verbose:
-                    n = max(vl.get("nex", 0.0), 1.0)
-                    print(f"[global-mesh] val pass {dp}: "
-                          f"logloss={vl.get('logloss', 0.0) / n:.6f}",
-                          flush=True)
-        if "val" in result and rank == 0 and verbose:
-            vl = result["val"]
-            n = max(vl.get("nex", 0.0), 1.0)
-            print(f"final val: logloss={vl.get('logloss', 0.0) / n:.6f} "
-                  f"auc={vl.get('auc', 0.0) / n:.6f} "
-                  f"acc={vl.get('acc', 0.0) / n:.6f}", flush=True)
-        if cfg.model_out and rank == 0:
-            # tables are replicated over the global mesh (model axis 1):
-            # fetch each process-locally and save single-file
-            class _GlobalView:
-                mesh = learner.mesh
+    if "val" in result and rank == 0 and verbose:
+        vl = result["val"]
+        n = max(vl.get("nex", 0.0), 1.0)
+        print(f"final val: logloss={vl.get('logloss', 0.0) / n:.6f} "
+              f"auc={vl.get('auc', 0.0) / n:.6f} "
+              f"acc={vl.get('acc', 0.0) / n:.6f}", flush=True)
+    if cfg.model_out and rank == 0:
+        # tables are replicated over the global mesh (model axis 1):
+        # fetch each process-locally and save single-file
+        class _GlobalView:
+            mesh = learner.mesh
 
-                @staticmethod
-                def to_numpy():
-                    return {k: mh.fetch_replicated(v)
-                            for k, v in _store(learner).state.items()}
+            @staticmethod
+            def to_numpy():
+                return {k: mh.fetch_replicated(v)
+                        for k, v in _store(learner).state.items()}
 
-            ckpt.save_model(_GlobalView, cfg.model_out)
-            if verbose:
-                print(f"model saved: {cfg.model_out}", flush=True)
-    finally:
-        stop_ping.set()
-        t.join(timeout=5)  # no in-flight ping may land after the bye
-        try:
-            client.call(op="bye")
-        except Exception:
-            pass
+        ckpt.save_model(_GlobalView, cfg.model_out)
+        if verbose:
+            print(f"model saved: {cfg.model_out}", flush=True)
     return result
 
 

@@ -3,14 +3,128 @@ learn/kmeans/kmeans.cc). Rabit-style key=value args:
 
   python -m wormhole_tpu.apps.kmeans data=... num_clusters=16 max_iter=10 \
       model_out=centroids.txt
+
+Multi-process (the reference's rabit world): launch with the tracker and
+global_mesh=1 — the workers form one jax.distributed mesh, each streams
+its rank-slice of file parts, and the per-iteration (k x d+1) statistics
+reduce over the mesh collectives (the rabit::Allreduce<Sum> of
+kmeans.cc:190):
+
+  python -m wormhole_tpu.launcher.dmlc_tpu -n 4 -s 0 -- \
+      python -m wormhole_tpu.apps.kmeans data=... global_mesh=1
 """
 
 from __future__ import annotations
 
 import sys
 
+import numpy as np
+
 from wormhole_tpu.apps._runner import parse_cli
 from wormhole_tpu.models.kmeans import KmeansConfig, KmeansLearner
+
+
+def _global_worker_body(cfg, env, client, verbose: bool = True) -> int:
+    """Lockstep SPMD Lloyd iterations over the global mesh (see
+    apps/_runner._run_worker_global for the pattern)."""
+    import jax
+    import jax.numpy as jnp
+
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.data.rowblock import to_device_batch
+    from wormhole_tpu.parallel import multihost as mh
+    from wormhole_tpu.parallel.mesh import (batch_sharding, make_mesh,
+                                            replicated)
+
+    rank, nproc = env.rank, env.num_workers
+    assert cfg.minibatch % nproc == 0
+    local_rows = cfg.minibatch // nproc
+    local_cap = local_rows * cfg.nnz_per_row
+    mine = mh.rank_parts(cfg.train_data, cfg.num_parts_per_file, env)
+
+    def local_blocks(seed=0):
+        for f, k in mine:
+            yield from MinibatchIter(f, k, cfg.num_parts_per_file,
+                                     cfg.data_format,
+                                     minibatch_size=local_rows, seed=seed)
+
+    # dim discovery: local max, then the global Allreduce<Max>
+    # (kmeans.cc:160)
+    if cfg.dim == 0:
+        local_max = -1
+        for blk in local_blocks():
+            if blk.nnz:
+                local_max = max(local_max, int(blk.index.max()))
+        cfg.dim = mh.global_scalar_max(local_max) + 1
+    learner = KmeansLearner(cfg, make_mesh())
+    mesh = learner.mesh
+    bsh = batch_sharding(mesh, 1)
+    k, d = cfg.num_clusters, cfg.dim
+
+    # centroid init: rank 0 picks random local rows and broadcasts them
+    # through the scheduler blob channel (kmeans.cc:89-106 with root 0)
+    if rank == 0:
+        rng = np.random.default_rng(cfg.seed)
+        rows = []
+        for blk in local_blocks():
+            X = np.zeros((blk.size, d), np.float32)
+            r = np.repeat(np.arange(blk.size),
+                          np.diff(blk.offset).astype(np.int64))
+            X[r, blk.index.astype(np.int64)] = blk.values_or_ones()
+            X /= np.maximum(np.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+            rows.append(X)
+            if sum(len(x) for x in rows) >= k * 8:
+                break
+        cand = np.concatenate(rows)
+        if len(cand) < k:
+            extra = cand[rng.integers(0, len(cand), k - len(cand))]
+            cand = np.concatenate(
+                [cand, extra + 0.01 * rng.standard_normal(extra.shape)
+                 .astype(np.float32)])
+        C0 = cand[rng.choice(len(cand), size=k, replace=False)]
+        client.blob_put("kmeans_init", C0.astype(np.float32))
+    C_host = client.blob_get("kmeans_init")
+    rsh = replicated(mesh)
+    C = jax.make_array_from_process_local_data(rsh, C_host,
+                                               global_shape=(k, d))
+
+    empty = mh.empty_rowblock()
+
+    def global_args(blk):
+        db = to_device_batch(blk, local_rows, local_cap, d)
+        return mh.global_coo_batch(bsh, db, rank, local_rows,
+                                   cfg.minibatch, cfg.nnz_per_row,
+                                   with_label=False)
+
+    cost = float("nan")
+    for it in range(cfg.max_iter):
+        sums = jnp.zeros((k, d), jnp.float32)
+        counts = jnp.zeros((k,), jnp.float32)
+        cost_acc = jnp.zeros((), jnp.float32)
+        blocks = local_blocks(seed=it)
+        while True:
+            blk = next(blocks, None)
+            s, c, co = learner._assign_accumulate(
+                C, *global_args(blk if blk is not None else empty))
+            # the per-step global row count decides continuation — a
+            # collective fact identical on every rank
+            if float(jnp.sum(c)) == 0:
+                break
+            sums, counts, cost_acc = sums + s, counts + c, cost_acc + co
+        new_C = jnp.where(counts[:, None] > 0,
+                          sums / jnp.maximum(counts[:, None], 1.0), C)
+        C = jax.device_put(new_C, rsh)
+        total = max(float(jnp.sum(counts)), 1.0)
+        cost = float(cost_acc) / total
+        if rank == 0 and verbose:
+            print(f"kmeans iter {it}: mean cosine distance {cost:.6f}",
+                  flush=True)
+    if rank == 0:
+        print(f"final cosine objective: {cost:.6f}", flush=True)
+        if cfg.model_out:
+            learner.centroids = mh.fetch_replicated(C)
+            learner.save(cfg.model_out)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -19,6 +133,21 @@ def main(argv=None) -> int:
     argv = [a.replace("data=", "train_data=", 1)
             if a.startswith("data=") else a for a in argv]
     cfg = parse_cli(KmeansConfig, argv)
+    if getattr(cfg, "global_mesh", False):
+        from wormhole_tpu.apps._runner import _run_scheduler_global
+        from wormhole_tpu.runtime.tracker import node_env
+
+        env = node_env()
+        if env.role is not None and env.role.value == "scheduler":
+            _run_scheduler_global(env)
+            return 0
+        if env.role is not None and env.role.value == "server":
+            return 0
+        if env.role is not None:
+            from wormhole_tpu.parallel import multihost as mh
+
+            with mh.worker_session(env) as client:
+                return _global_worker_body(cfg, env, client)
     lrn = KmeansLearner(cfg)
     objv = lrn.run()
     print(f"final cosine objective: {objv:.6f}", flush=True)

@@ -43,11 +43,64 @@ class LbfgsLinearConfig:
     minibatch: int = 4096
     nnz_per_row: int = 64
     num_parts_per_file: int = 1
+    # multi-process SPMD over one jax.distributed mesh: the weight vector
+    # and history shard over every process's devices (the reference's
+    # rank partition, lbfgs.h:127-136) and all dot products ride the
+    # mesh collectives
+    global_mesh: bool = False
+
+
+def _global_worker_body(cfg, env, client) -> int:
+    import jax
+
+    from wormhole_tpu.models.batch_objectives import load_batches_global
+    from wormhole_tpu.parallel import multihost as mh
+    from wormhole_tpu.parallel.mesh import replicated
+
+    rank = env.rank
+    mesh = make_mesh()
+    batches, num_feature = load_batches_global(
+        cfg.data, mesh, env, cfg.data_format, cfg.minibatch,
+        cfg.nnz_per_row, cfg.num_parts_per_file)
+    obj = LinearObjFunction(batches, num_feature, mesh)
+    solver = LBFGSSolver(obj, LBFGSConfig(
+        max_iter=cfg.max_lbfgs_iter, m=cfg.m, reg_l1=cfg.reg_L1,
+        reg_l2=cfg.reg_L2, min_rel_decrease=cfg.lbfgs_stop_tol))
+    # every rank drives the identical host loop on identical global
+    # scalars, so all jitted collectives stay in lockstep
+    w, objv = solver.run(verbose=(rank == 0))
+    if cfg.model_out:
+        # the replication all-gather is a COLLECTIVE: every rank must run
+        # it, then only rank 0 writes the file
+        full = jax.jit(lambda x: x, out_shardings=replicated(mesh))(w)
+        w_host = mh.fetch_replicated(full)
+        if rank == 0:
+            np.savez(cfg.model_out, w=w_host, num_feature=num_feature)
+            print(f"saved model to {cfg.model_out}", flush=True)
+    if rank == 0:
+        print(f"final objective: {objv:.6f}", flush=True)
+    return 0
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cfg = parse_cli(LbfgsLinearConfig, argv)
+    if cfg.global_mesh:
+        from wormhole_tpu.apps._runner import _run_scheduler_global
+        from wormhole_tpu.runtime.tracker import node_env
+
+        env = node_env()
+        if env.role is not None and env.role.value == "scheduler":
+            _run_scheduler_global(env)
+            return 0
+        if env.role is not None and env.role.value == "server":
+            return 0
+        if env.role is not None:
+            assert cfg.task == "train", "global_mesh supports task=train"
+            from wormhole_tpu.parallel import multihost as mh
+
+            with mh.worker_session(env) as client:
+                return _global_worker_body(cfg, env, client)
     mesh = make_mesh()
     if cfg.task == "pred":
         # the reference's TaskPred: load binf model, write one margin per

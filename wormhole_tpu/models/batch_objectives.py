@@ -57,6 +57,43 @@ def load_batches(pattern: str, mesh, fmt: str = "libsvm",
     return batches, max_id + 1
 
 
+def load_batches_global(pattern: str, mesh, env, fmt: str = "libsvm",
+                        minibatch: int = 4096, nnz_per_row: int = 64,
+                        num_parts_per_file: int = 1):
+    """Multi-process variant of load_batches (requires an initialized
+    jax.distributed cluster): each process reads its rank-slice of file
+    parts (the reference RowBlockIter(rank, world) split, lbfgs.cc:
+    229-234) and contributes minibatch/num_workers rows of every GLOBAL
+    batch; ranks with fewer local batches pad with masked empties so all
+    processes hold the same batch count — every eval/grad over a batch
+    is an SPMD collective and must run in lockstep."""
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.parallel import multihost as mh
+
+    rank, nproc = env.rank, env.num_workers
+    assert minibatch % nproc == 0, (minibatch, nproc)
+    local_rows = minibatch // nproc
+    local_cap = local_rows * nnz_per_row
+    local, max_id = [], -1
+    for f, k in mh.rank_parts(pattern, num_parts_per_file, env):
+        for blk in MinibatchIter(f, k, num_parts_per_file, fmt,
+                                 minibatch_size=local_rows):
+            if blk.nnz:
+                max_id = max(max_id, int(blk.index.max()))
+            local.append(blk)
+    n_batches = mh.global_scalar_max(len(local))
+    num_feature = mh.global_scalar_max(max_id) + 1
+    empty = mh.empty_rowblock()
+    bsh = batch_sharding(mesh, 1)
+    out = []
+    for i in range(n_batches):
+        blk = local[i] if i < len(local) else empty
+        db = to_device_batch(blk, local_rows, local_cap, 2 ** 31 - 1)
+        out.append(mh.global_coo_batch(bsh, db, rank, local_rows,
+                                       minibatch, nnz_per_row))
+    return out, num_feature
+
+
 class _BatchObjBase:
     """Shared accumulate-over-batches eval/grad driver.
 
@@ -104,7 +141,11 @@ class _BatchObjBase:
         pad = self.num_dim_padded - p.shape[0]
         if pad:
             p = jnp.concatenate([p, jnp.zeros(pad, p.dtype)])
-        return jax.device_put(p, self._psh)
+        p = np.asarray(p)
+        # make_array_from_callback works on multi-process meshes too
+        # (device_put cannot target non-addressable devices)
+        return jax.make_array_from_callback(
+            p.shape, self._psh, lambda idx: p[idx])
 
     def pad_mask(self, m):
         """Extend a logical-length mask to the padded vector (padding 0)."""

@@ -42,6 +42,9 @@ class KmeansConfig:
     model_out: Optional[str] = None
     checkpoint_dir: Optional[str] = None  # per-iter state for resume
     seed: int = 0
+    # multi-process SPMD over one jax.distributed mesh (apps/kmeans.py
+    # _global_worker; the reference's rabit world)
+    global_mesh: bool = False
     # assignment kernel: dense ([B, d] densify + two MXU matmuls — best
     # for small/moderate d like MNIST-784) | sparse (per-nonzero gathers
     # and scatter-adds, never materializing [B, d] — required for huge

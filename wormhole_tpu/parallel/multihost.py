@@ -21,6 +21,8 @@ apps/_runner._run_worker_global).
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 
@@ -68,3 +70,105 @@ def fetch_replicated(arr) -> np.ndarray:
     """Host copy of a fully-replicated global array (every process holds
     a complete shard set, so this is purely local)."""
     return np.asarray(arr.addressable_data(0))
+
+
+def exit_barrier(client=None, world: int = 0,
+                 timeout: float = 120.0) -> None:
+    """Rendezvous before process exit: the coordination-service leader
+    (process 0) tearing down while peers are still running kills them
+    with a fatal poll error. A HOST-level barrier (the scheduler's TCP
+    barrier — device collectives cannot serialize process exit) gets
+    every worker to the same point, then all shut the jax.distributed
+    client down together. Bounded: a peer that died before arriving must
+    not hang the survivors forever."""
+    import jax
+
+    if client is not None and world > 1:
+        try:
+            client.barrier("gm_exit", world, timeout=timeout)
+        except Exception:
+            pass
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def worker_session(env):
+    """The global-mesh worker frame shared by every SPMD app: register
+    with the control plane and START LIVENESS PINGS before the blocking
+    jax.distributed rendezvous (a slow peer must not get this worker
+    swept as dead mid-init), and guarantee the coordinated teardown —
+    exit barrier, distributed shutdown, deregistration — on every exit
+    path, including exceptions (a crashed rank must not strand its peers
+    in a collective)."""
+    from wormhole_tpu.runtime.tracker import LivenessPinger, SchedulerClient
+
+    client = SchedulerClient(env.scheduler_uri, f"worker-{env.rank}")
+    client.register()
+    pinger = LivenessPinger(client)
+    try:
+        assert init_from_env(env), "global_mesh needs WH_COORD_URI"
+        yield client
+    finally:
+        exit_barrier(client, env.num_workers)
+        pinger.stop()
+        try:
+            client.call(op="bye")
+        except Exception:
+            pass
+
+
+def rank_parts(pattern: str, num_parts_per_file: int, env) -> list:
+    """This rank's stable slice of (file, part) work items — the
+    reference's RowBlockIter(rank, world) split (kmeans.cc:149-154)."""
+    from wormhole_tpu.data.match_file import match_file
+
+    files = match_file(pattern)
+    if not files:
+        raise FileNotFoundError(f"no files match {pattern}")
+    parts = [(f, k) for f in files for k in range(num_parts_per_file)]
+    return parts[env.rank :: env.num_workers]
+
+
+def empty_rowblock():
+    """The masked-empty block a drained rank feeds into lockstep steps."""
+    from wormhole_tpu.data.rowblock import RowBlock
+
+    return RowBlock(label=np.zeros(0, np.float32),
+                    offset=np.zeros(1, np.int64),
+                    index=np.zeros(0, np.uint64), value=None, weight=None)
+
+
+def global_coo_batch(bsh, db, rank: int, local_rows: int,
+                     minibatch: int, nnz_per_row: int,
+                     with_label: bool = True):
+    """Assemble this rank's local DeviceBatch rows into the global
+    sharded batch arrays (seg ids offset into the rank's global row
+    range; padding rows carry val=0 so offsets on padding are inert)."""
+    cap = minibatch * nnz_per_row
+    seg = db.seg + np.int32(rank * local_rows)
+    out = [global_batch(bsh, seg, cap),
+           global_batch(bsh, db.idx, cap),
+           global_batch(bsh, db.val, cap)]
+    if with_label:
+        out.append(global_batch(bsh, db.label, minibatch))
+    out.append(global_batch(bsh, db.row_mask, minibatch))
+    return tuple(out)
+
+
+def global_scalar_max(local_value: int) -> int:
+    """Max of a per-process host integer over the global mesh — the
+    Allreduce<Max> of the reference BSP apps (lbfgs.cc:107-113)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("i",))
+    sh = NamedSharding(mesh, P("i"))
+    per = np.full(len(jax.local_devices()), local_value, np.int64)
+    garr = jax.make_array_from_process_local_data(
+        sh, per, global_shape=(len(devs),))
+    return int(jnp.max(garr))

@@ -301,6 +301,18 @@ class Scheduler:
             with self._lock:
                 self.progress.merge(req.get("progress", {}))
             return {"ok": True}
+        if op == "blob_put":
+            # tiny rendezvous KV (host-side rabit::Broadcast payloads,
+            # e.g. the k-means centroid init from rank 0)
+            with self._lock:
+                if not hasattr(self, "_blobs"):
+                    self._blobs = {}
+                self._blobs[req["key"]] = req["data"]
+            return {"ok": True}
+        if op == "blob_get":
+            with self._lock:
+                data = getattr(self, "_blobs", {}).get(req["key"])
+            return {"ok": data is not None, "data": data}
         if op == "bye":
             # explicit deregistration (global-mesh workers) so liveness
             # does not have to time the node out
@@ -400,12 +412,44 @@ class SchedulerClient:
     def register(self) -> dict:
         return self.call(op="register")
 
+    def blob_put(self, key: str, arr) -> None:
+        """Broadcast a small host array through the scheduler (the
+        rabit::Broadcast host path for BSP init payloads)."""
+        import base64
+        import io
+
+        import numpy as np
+
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr))
+        self.call(op="blob_put", key=key,
+                  data=base64.b64encode(buf.getvalue()).decode())
+
+    def blob_get(self, key: str, timeout: float = 60.0, poll: float = 0.1):
+        import base64
+        import io
+
+        import numpy as np
+
+        deadline = time.monotonic() + timeout
+        while True:
+            r = self.call(op="blob_get", key=key)
+            if r.get("ok"):
+                return np.load(io.BytesIO(base64.b64decode(r["data"])))
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"blob {key!r} never published")
+            time.sleep(poll)
+
     def report(self, progress: dict) -> None:
         self.call(op="report", progress=progress)
 
-    def barrier(self, name: str, world: int, poll: float = 0.1) -> None:
+    def barrier(self, name: str, world: int, poll: float = 0.1,
+                timeout: Optional[float] = None) -> None:
         """Block until `world` distinct nodes reach the named barrier
-        (rabit tracker rendezvous parity for the BSP apps)."""
+        (rabit tracker rendezvous parity for the BSP apps). With a
+        timeout, raises TimeoutError instead of waiting forever for a
+        peer that died before arriving."""
+        deadline = (time.monotonic() + timeout) if timeout else None
         r = self.call(op="barrier", name=name, world=world)
         if r["released"]:
             return
@@ -414,6 +458,33 @@ class SchedulerClient:
             time.sleep(poll)
             if self.call(op="barrier_wait", name=name, gen=gen)["released"]:
                 return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"barrier {name!r} never released")
+
+
+class LivenessPinger:
+    """Background liveness pings for workers whose main thread runs long
+    device computations (global-mesh BSP loops): without them the
+    scheduler's sweep would declare the worker dead mid-solve."""
+
+    def __init__(self, client: SchedulerClient, interval: float = 2.0):
+        import threading
+
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    client.call(op="epoch")
+                except Exception:
+                    pass
+
+        self._t = threading.Thread(target=loop, daemon=True)
+        self._t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=5)
 
 
 class RemotePool:
