@@ -69,6 +69,28 @@ def run_minibatch_app(cfg, make_learner, verbose: bool = True) -> dict:
     return _run_worker(cfg, env, make_learner, verbose)
 
 
+def maybe_run_global(cfg, worker_body):
+    """Role dispatch for global-mesh BSP apps: returns an exit code when
+    this process has a distributed role under global_mesh=1, else None
+    (caller falls through to the single-process path). `worker_body` is
+    called as worker_body(cfg, env, client) inside a multihost
+    worker_session."""
+    if not getattr(cfg, "global_mesh", False):
+        return None
+    env = node_env()
+    if env.role is None:
+        return None
+    if env.role.value == "scheduler":
+        _run_scheduler_global(env)
+        return 0
+    if env.role.value == "server":
+        return 0
+    from wormhole_tpu.parallel import multihost as mh
+
+    with mh.worker_session(env) as client:
+        return worker_body(cfg, env, client)
+
+
 def _run_scheduler_global(env) -> dict:
     """Global-mesh mode scheduler: pure liveness — the SPMD collectives
     synchronize the workers, so the control plane only keeps the launcher

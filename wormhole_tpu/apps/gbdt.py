@@ -12,9 +12,132 @@ from wormhole_tpu.apps._runner import parse_cli
 from wormhole_tpu.models.gbdt import GbdtConfig, GbdtLearner
 
 
+def _global_worker_body(cfg, env, client) -> int:
+    """Multi-process GBDT over the global mesh: the reference runs the
+    xgboost CLI over rabit with dsplit=row (mushroom.hadoop.conf:36) —
+    here the row axis of the binned matrix shards over every process's
+    devices, the per-level histograms psum across them, and every rank
+    drives the identical boosting loop in lockstep."""
+    import jax
+    import numpy as np
+
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.models.gbdt import (BinnedDataset, Reservoir,
+                                          _densify, _densify_sample,
+                                          _SKETCH_ROWS, bin_matrix,
+                                          quantile_edges)
+    from wormhole_tpu.parallel import multihost as mh
+    from wormhole_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    if cfg.model_in:
+        raise NotImplementedError(
+            "model_in warm start is not supported in global_mesh mode "
+            "yet; warm-start single-process or drop global_mesh")
+    rank, nproc = env.rank, env.num_workers
+
+    def my_pattern_parts(pattern):
+        return mh.rank_parts(pattern, cfg.num_parts_per_file, env)
+
+    # global quantile sketch: ONE reservoir per rank over exactly its
+    # (file, part) slice — every row of the rank's shard has equal
+    # inclusion probability; rank 0 merges the per-rank samples and fits
+    # the shared edges (the xgboost distributed sketch, approximated
+    # over the blob channel). Samples travel as sparse triples, not
+    # dense matrices.
+    res = Reservoir(_SKETCH_ROWS // max(nproc, 1), cfg.seed + rank)
+    for f, k in my_pattern_parts(cfg.train_data):
+        for blk in MinibatchIter(f, k, cfg.num_parts_per_file,
+                                 cfg.data_format,
+                                 minibatch_size=cfg.minibatch):
+            res.add_block(blk)
+    if cfg.dim == 0:
+        cfg.dim = mh.global_scalar_max(res.max_feat) + 1
+    sidx = (np.concatenate([r[0] for r in res.sample])
+            if res.sample else np.zeros(0, np.uint64))
+    sval = (np.concatenate([r[1] for r in res.sample])
+            if res.sample else np.zeros(0, np.float32))
+    soff = np.zeros(len(res.sample) + 1, np.int64)
+    np.cumsum([len(r[0]) for r in res.sample], out=soff[1:])
+    client.blob_put(f"gbdt_sketch_{rank}",
+                    {"idx": sidx.astype(np.uint64), "val": sval,
+                     "off": soff})
+    if rank == 0:
+        rows = []
+        for r in range(nproc):
+            p = client.blob_get(f"gbdt_sketch_{r}", timeout=120)
+            rows.extend((p["idx"][lo:hi], p["val"][lo:hi])
+                        for lo, hi in zip(p["off"], p["off"][1:]))
+        edges = quantile_edges(_densify_sample(rows, cfg.dim), cfg.max_bin)
+        client.blob_put("gbdt_edges", edges)
+    edges = client.blob_get("gbdt_edges", timeout=120)
+
+    mesh = make_mesh()
+    n_local_dev = len(jax.local_devices())
+
+    def load_global(pattern):
+        chunks, labels = [], []
+        for f, k in my_pattern_parts(pattern):
+            for blk in MinibatchIter(f, k, cfg.num_parts_per_file,
+                                     cfg.data_format,
+                                     minibatch_size=cfg.minibatch):
+                chunks.append(bin_matrix(_densify(blk, cfg.dim), edges))
+                labels.append(blk.label.astype(np.float32))
+        n = sum(c.shape[0] for c in chunks)
+        # every process must hold the same padded row count, aligned to
+        # its local device count (the global array interleaves
+        # rank-contiguous blocks)
+        n_max = mh.global_scalar_max(n)
+        n_pad = -(-max(n_max, 1) // n_local_dev) * n_local_dev
+        binned = np.zeros((n_pad, cfg.dim), np.uint8)
+        label = np.zeros(n_pad, np.float32)
+        mask = np.zeros(n_pad, np.float32)
+        if n:
+            binned[:n] = np.concatenate(chunks)
+            label[:n] = np.concatenate(labels)
+            mask[:n] = 1.0
+        b1 = batch_sharding(mesh, 1)
+        b2 = batch_sharding(mesh, 2)
+        N = n_pad * nproc
+        return BinnedDataset(
+            binned=mh.global_batch(b2, binned, N),
+            label=mh.global_batch(b1, label, N),
+            mask=mh.global_batch(b1, mask, N),
+            num_real=mh.global_scalar_sum(n),
+        ), n
+
+    lrn = GbdtLearner(cfg, mesh)
+    lrn.edges = edges
+    train, _ = load_global(cfg.train_data)
+    evals = []
+    if cfg.eval_data:
+        evals.append((cfg.eval_name, load_global(cfg.eval_data)[0]))
+    if cfg.eval_train:
+        evals.append(("train", train))
+    if rank != 0:
+        cfg.model_out = None  # single writer
+    last = lrn.fit_prepared(train, evals, verbose=(rank == 0))
+    if rank == 0:
+        for name, m in last.items():
+            print("final " + name + ": "
+                  + " ".join(f"{k}={v:.6f}" for k, v in m.items()),
+                  flush=True)
+        if cfg.model_out:
+            print(f"saved model to {cfg.model_out}", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cfg = parse_cli(GbdtConfig, argv)
+    from wormhole_tpu.apps._runner import maybe_run_global
+
+    def body(cfg, env, client):
+        assert cfg.task == "train", "global_mesh supports task=train"
+        return _global_worker_body(cfg, env, client)
+
+    rc = maybe_run_global(cfg, body)
+    if rc is not None:
+        return rc
     lrn = GbdtLearner(cfg)
     if cfg.task == "pred":
         # xgboost CLI task=pred: load model, write one probability/value

@@ -133,21 +133,11 @@ def main(argv=None) -> int:
     argv = [a.replace("data=", "train_data=", 1)
             if a.startswith("data=") else a for a in argv]
     cfg = parse_cli(KmeansConfig, argv)
-    if getattr(cfg, "global_mesh", False):
-        from wormhole_tpu.apps._runner import _run_scheduler_global
-        from wormhole_tpu.runtime.tracker import node_env
+    from wormhole_tpu.apps._runner import maybe_run_global
 
-        env = node_env()
-        if env.role is not None and env.role.value == "scheduler":
-            _run_scheduler_global(env)
-            return 0
-        if env.role is not None and env.role.value == "server":
-            return 0
-        if env.role is not None:
-            from wormhole_tpu.parallel import multihost as mh
-
-            with mh.worker_session(env) as client:
-                return _global_worker_body(cfg, env, client)
+    rc = maybe_run_global(cfg, _global_worker_body)
+    if rc is not None:
+        return rc
     lrn = KmeansLearner(cfg)
     objv = lrn.run()
     print(f"final cosine objective: {objv:.6f}", flush=True)

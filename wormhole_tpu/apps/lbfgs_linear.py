@@ -85,22 +85,15 @@ def _global_worker_body(cfg, env, client) -> int:
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cfg = parse_cli(LbfgsLinearConfig, argv)
-    if cfg.global_mesh:
-        from wormhole_tpu.apps._runner import _run_scheduler_global
-        from wormhole_tpu.runtime.tracker import node_env
+    from wormhole_tpu.apps._runner import maybe_run_global
 
-        env = node_env()
-        if env.role is not None and env.role.value == "scheduler":
-            _run_scheduler_global(env)
-            return 0
-        if env.role is not None and env.role.value == "server":
-            return 0
-        if env.role is not None:
-            assert cfg.task == "train", "global_mesh supports task=train"
-            from wormhole_tpu.parallel import multihost as mh
+    def body(cfg, env, client):
+        assert cfg.task == "train", "global_mesh supports task=train"
+        return _global_worker_body(cfg, env, client)
 
-            with mh.worker_session(env) as client:
-                return _global_worker_body(cfg, env, client)
+    rc = maybe_run_global(cfg, body)
+    if rc is not None:
+        return rc
     mesh = make_mesh()
     if cfg.task == "pred":
         # the reference's TaskPred: load binf model, write one margin per

@@ -72,6 +72,9 @@ class GbdtConfig:
     dsplit: str = "row"                  # only row split is supported
     base_score: float = 0.5
 
+    # multi-process SPMD over one jax.distributed mesh (apps/gbdt.py
+    # _global_worker_body; the reference's rabit world)
+    global_mesh: bool = False
     # TPU-native knobs
     max_bin: int = 256
     dim: int = 0        # feature count; 0 = discover from data
@@ -87,37 +90,49 @@ class GbdtConfig:
 _SKETCH_ROWS = 1 << 17  # quantile-sketch sample cap (approx sketch parity)
 
 
-def _reservoir_sample(pattern: str, fmt: str, num_parts_per_file: int,
-                      minibatch: int, seed: int,
-                      cap: int = _SKETCH_ROWS):
-    """One streaming pass: reservoir-sample up to `cap` rows (kept as
-    sparse (index, value, label) triples so no dense matrix exists before
-    the feature count is known) and discover the feature dimension — the
-    global approx sketch + Allreduce<Max> dim discovery of xgboost
-    without materializing the dataset."""
-    rng = np.random.default_rng(seed)
-    sample: list = []
-    n_seen = 0
-    max_feat = -1
-    for blk in iter_rowblocks(pattern, num_parts_per_file, fmt,
-                              minibatch, node="gbdt-sketch", seed=seed):
+class Reservoir:
+    """Uniform reservoir of sparse rows over any RowBlock stream (rows
+    kept as (index, value) triples so no dense matrix exists before the
+    feature count is known); tracks the running max feature id."""
+
+    def __init__(self, cap: int, seed: int):
+        self.cap = max(int(cap), 1)
+        self.rng = np.random.default_rng(seed)
+        self.sample: list = []
+        self.n_seen = 0
+        self.max_feat = -1
+
+    def add_block(self, blk: RowBlock) -> None:
         if blk.nnz:
-            max_feat = max(max_feat, int(blk.index.max()))
+            self.max_feat = max(self.max_feat, int(blk.index.max()))
         vals = blk.values_or_ones()
         for r in range(blk.size):
             lo, hi = blk.offset[r], blk.offset[r + 1]
             row = (blk.index[lo:hi].copy(), vals[lo:hi].copy())
-            if len(sample) < cap:
-                sample.append(row)
+            if len(self.sample) < self.cap:
+                self.sample.append(row)
             else:
                 # classic reservoir: keep each new row with prob cap/n
-                j = rng.integers(0, n_seen + 1)
-                if j < cap:
-                    sample[j] = row
-            n_seen += 1
-    if n_seen == 0:
+                j = self.rng.integers(0, self.n_seen + 1)
+                if j < self.cap:
+                    self.sample[j] = row
+            self.n_seen += 1
+
+
+def _reservoir_sample(pattern: str, fmt: str, num_parts_per_file: int,
+                      minibatch: int, seed: int,
+                      cap: int = _SKETCH_ROWS):
+    """One streaming pass: reservoir-sample up to `cap` rows and
+    discover the feature dimension — the global approx sketch +
+    Allreduce<Max> dim discovery of xgboost without materializing the
+    dataset."""
+    res = Reservoir(cap, seed)
+    for blk in iter_rowblocks(pattern, num_parts_per_file, fmt,
+                              minibatch, node="gbdt-sketch", seed=seed):
+        res.add_block(blk)
+    if res.n_seen == 0:
         raise ValueError(f"no rows in {pattern}")
-    return sample, n_seen, max_feat
+    return res.sample, res.n_seen, res.max_feat
 
 
 def _densify_sample(sample, dim: int) -> np.ndarray:
@@ -419,6 +434,15 @@ class GbdtLearner:
             evals.append((cfg.eval_name, self.load_dataset(cfg.eval_data)))
         if cfg.eval_train:
             evals.append(("train", train))
+        return self.fit_prepared(train, evals, r0=r0, verbose=verbose)
+
+    def fit_prepared(self, train: BinnedDataset, evals, r0: int = 0,
+                     verbose: bool = True) -> dict:
+        """The boosting loop over already-loaded datasets — the entry the
+        multi-process global-mesh app uses after assembling globally
+        sharded datasets (every process must call this in lockstep: each
+        round's histogram/split/metric steps are collectives)."""
+        cfg = self.cfg
         prior = self.trees
         self.trees = _empty_trees(cfg)
         for k in self.trees:

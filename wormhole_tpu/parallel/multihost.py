@@ -158,6 +158,24 @@ def global_coo_batch(bsh, db, rank: int, local_rows: int,
     return tuple(out)
 
 
+def global_scalar_sum(local_value: int) -> int:
+    """Sum of a per-process host integer over the global mesh (each
+    process's value is counted once, not per device)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("i",))
+    sh = NamedSharding(mesh, P("i"))
+    n_local = len(jax.local_devices())
+    per = np.zeros(n_local, np.int64)
+    per[0] = local_value
+    garr = jax.make_array_from_process_local_data(
+        sh, per, global_shape=(len(devs),))
+    return int(jnp.sum(garr))
+
+
 def global_scalar_max(local_value: int) -> int:
     """Max of a per-process host integer over the global mesh — the
     Allreduce<Max> of the reference BSP apps (lbfgs.cc:107-113)."""

@@ -413,15 +413,20 @@ class SchedulerClient:
         return self.call(op="register")
 
     def blob_put(self, key: str, arr) -> None:
-        """Broadcast a small host array through the scheduler (the
-        rabit::Broadcast host path for BSP init payloads)."""
+        """Broadcast a small host payload (one array, or a dict of
+        arrays) through the scheduler — the rabit::Broadcast host path
+        for BSP init payloads like centroid seeds and quantile-sketch
+        summaries."""
         import base64
         import io
 
         import numpy as np
 
         buf = io.BytesIO()
-        np.save(buf, np.asarray(arr))
+        if isinstance(arr, dict):
+            np.savez(buf, **arr)
+        else:
+            np.save(buf, np.asarray(arr))
         self.call(op="blob_put", key=key,
                   data=base64.b64encode(buf.getvalue()).decode())
 
@@ -435,7 +440,10 @@ class SchedulerClient:
         while True:
             r = self.call(op="blob_get", key=key)
             if r.get("ok"):
-                return np.load(io.BytesIO(base64.b64decode(r["data"])))
+                got = np.load(io.BytesIO(base64.b64decode(r["data"])))
+                if hasattr(got, "files"):  # npz: dict payload
+                    return {k: got[k] for k in got.files}
+                return got
             if time.monotonic() > deadline:
                 raise TimeoutError(f"blob {key!r} never published")
             time.sleep(poll)
