@@ -179,3 +179,38 @@ def test_native_throughput_exceeds_python(lib):
     t_py = time.perf_counter() - t0
     _assert_blocks_equal(a, b)
     assert t_native < t_py / 3, (t_native, t_py)
+
+
+def test_radix_argsort_matches_numpy():
+    """Native LSD radix argsort must be a stable argsort for every
+    accepted dtype, including empty input."""
+    from wormhole_tpu import native
+
+    if native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(9)
+    for dtype in (np.uint32, np.uint64, np.int32, np.int64):
+        keys = rng.integers(0, 1 << 20, 50_000).astype(dtype)
+        got = native.radix_argsort(keys)
+        np.testing.assert_array_equal(got, np.argsort(keys, kind="stable"))
+    assert native.radix_argsort(np.zeros(0, np.uint64)).shape == (0,)
+    # full 64-bit range (hashed criteo keys use all bits)
+    big = rng.integers(0, 2 ** 63, 50_000, dtype=np.int64).astype(np.uint64)
+    big |= np.uint64(1) << np.uint64(63)
+    np.testing.assert_array_equal(native.radix_argsort(big),
+                                  np.argsort(big, kind="stable"))
+
+
+def test_localize_native_path_matches_unique():
+    """localize over the native sort must equal the np.unique contract."""
+    import wormhole_tpu.native as native
+    from wormhole_tpu.ops.localizer import localize
+
+    rng = np.random.default_rng(10)
+    keys = rng.integers(0, 500, 20_000).astype(np.uint64)
+    loc = localize(keys)
+    uniq, inv, counts = np.unique(keys, return_inverse=True,
+                                  return_counts=True)
+    np.testing.assert_array_equal(loc.uniq_keys, uniq)
+    np.testing.assert_array_equal(loc.local_index, inv.astype(np.int32))
+    np.testing.assert_array_equal(loc.counts, counts.astype(np.int32))

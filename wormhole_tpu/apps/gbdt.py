@@ -51,7 +51,7 @@ def _global_worker_body(cfg, env, client) -> int:
                                  minibatch_size=cfg.minibatch):
             res.add_block(blk)
     if cfg.dim == 0:
-        cfg.dim = mh.global_scalar_max(res.max_feat) + 1
+        cfg.dim = max(mh.global_scalar_max(res.max_feat) + 1, 1)
     sidx = (np.concatenate([r[0] for r in res.sample])
             if res.sample else np.zeros(0, np.uint64))
     sval = (np.concatenate([r[1] for r in res.sample])
@@ -69,6 +69,8 @@ def _global_worker_body(cfg, env, client) -> int:
                         for lo, hi in zip(p["off"], p["off"][1:]))
         edges = quantile_edges(_densify_sample(rows, cfg.dim), cfg.max_bin)
         client.blob_put("gbdt_edges", edges)
+        for r in range(nproc):
+            client.call(op="blob_del", key=f"gbdt_sketch_{r}")
     edges = client.blob_get("gbdt_edges", timeout=120)
 
     mesh = make_mesh()

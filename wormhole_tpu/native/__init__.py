@@ -144,3 +144,58 @@ def cityhash64(data) -> int:
 
         return py(s)
     return int(lib.wh_cityhash64(s, len(s)))
+
+
+def radix_argsort(keys):
+    """Stable argsort of uint32/uint64 keys via the native LSD radix sort;
+    returns int32 order, or None when the native path is unavailable
+    (callers fall back to np.argsort)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys)
+    n = keys.shape[0]
+    if n >= 2 ** 31:
+        return None
+    out = np.empty(n, np.int32)
+    if keys.dtype == np.uint32:
+        fn = lib.wh_argsort_u32
+    elif keys.dtype == np.uint64:
+        fn = lib.wh_argsort_u64
+    elif keys.dtype == np.int32 and (n == 0 or keys.min() >= 0):
+        keys = keys.view(np.uint32)
+        fn = lib.wh_argsort_u32
+    elif keys.dtype == np.int64 and (n == 0 or keys.min() >= 0):
+        keys = keys.astype(np.uint64)
+        fn = lib.wh_argsort_u64
+    else:
+        return None
+    fn.restype = None
+    fn(keys.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(n),
+       out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def gather(src, order):
+    """out[i] = src[order[i]] via the parallel native core for 4/8-byte
+    element types; None when unavailable (callers use numpy indexing)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src)
+    if src.ndim != 1 or len(order) >= 2 ** 31 or src.shape[0] >= 2 ** 31:
+        return None  # int32 index domain only; callers fall back to numpy
+    order = np.ascontiguousarray(order, dtype=np.int32)
+    n = order.shape[0]
+    if src.dtype.itemsize == 4:
+        fn = lib.wh_gather_32
+    elif src.dtype.itemsize == 8:
+        fn = lib.wh_gather_64
+    else:
+        return None
+    out = np.empty(n, src.dtype)
+    fn.restype = None
+    fn(src.ctypes.data_as(ctypes.c_void_p),
+       order.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(n),
+       out.ctypes.data_as(ctypes.c_void_p))
+    return out

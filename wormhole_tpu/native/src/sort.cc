@@ -1,0 +1,125 @@
+// Radix sorts for the hot host-side packing path.
+//
+// The Pallas COO pack (ops/coo_kernels.pack_sorted_coo) and the
+// Localizer (ops/localizer.py) argsort each minibatch's bucket ids —
+// ~640k keys at Criteo shape. numpy's comparison argsort costs ~45 ms
+// there; an LSD radix pass over 32-bit keys is ~5-8x faster, keeping
+// the loader pipeline ahead of a ~2.5M-examples/sec device. This plays
+// the role of the reference's parallel_sort.h (learn/base/
+// parallel_sort.h) in its Localizer hot path.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+// Parallel LSD radix argsort, 8 bits per pass (stable): each thread
+// histograms its contiguous chunk, a (bucket-major, thread-minor)
+// prefix assigns disjoint output ranges, then each thread places its
+// chunk — the classic parallel counting sort, the analog of the
+// reference's thread-recursive parallel_sort.h.
+template <typename K>
+void radix_argsort(const K* keys, int64_t n, int32_t* out) {
+  constexpr int kBits = 8;
+  constexpr int kBuckets = 1 << kBits;
+  constexpr int kPasses = static_cast<int>(sizeof(K));
+#ifdef _OPENMP
+  const int nt = n > (1 << 16) ? omp_get_max_threads() : 1;
+#else
+  const int nt = 1;
+#endif
+  std::vector<int32_t> tmp(n);
+  std::vector<K> kcur(keys, keys + n);
+  std::vector<K> ktmp(n);
+  for (int64_t i = 0; i < n; ++i) out[i] = static_cast<int32_t>(i);
+  int32_t* src = out;
+  int32_t* dst = tmp.data();
+  K* ksrc = kcur.data();
+  K* kdst = ktmp.data();
+  std::vector<int64_t> counts(static_cast<size_t>(nt) * kBuckets);
+  for (int pass = 0; pass < kPasses; ++pass) {
+    const int shift = pass * kBits;
+    std::memset(counts.data(), 0, counts.size() * sizeof(int64_t));
+#pragma omp parallel for num_threads(nt) schedule(static)
+    for (int t = 0; t < nt; ++t) {
+      const int64_t lo = n * t / nt, hi = n * (t + 1) / nt;
+      int64_t* c = counts.data() + static_cast<size_t>(t) * kBuckets;
+      for (int64_t i = lo; i < hi; ++i)
+        ++c[(ksrc[i] >> shift) & (kBuckets - 1)];
+    }
+    // skip passes whose byte is constant (common for bucket ids well
+    // below 2^32)
+    int nonzero = 0;
+    for (int b = 0; b < kBuckets && nonzero <= 1; ++b) {
+      int64_t tot = 0;
+      for (int t = 0; t < nt; ++t)
+        tot += counts[static_cast<size_t>(t) * kBuckets + b];
+      nonzero += tot != 0;
+    }
+    if (nonzero <= 1) continue;
+    // bucket-major, thread-minor exclusive prefix: thread t's share of
+    // bucket b starts after all threads' smaller buckets and earlier
+    // threads' bucket b — this preserves stability
+    int64_t pos = 0;
+    for (int b = 0; b < kBuckets; ++b) {
+      for (int t = 0; t < nt; ++t) {
+        int64_t& c = counts[static_cast<size_t>(t) * kBuckets + b];
+        const int64_t cc = c;
+        c = pos;
+        pos += cc;
+      }
+    }
+#pragma omp parallel for num_threads(nt) schedule(static)
+    for (int t = 0; t < nt; ++t) {
+      const int64_t lo = n * t / nt, hi = n * (t + 1) / nt;
+      int64_t* c = counts.data() + static_cast<size_t>(t) * kBuckets;
+      for (int64_t i = lo; i < hi; ++i) {
+        const int64_t p = c[(ksrc[i] >> shift) & (kBuckets - 1)]++;
+        dst[p] = src[i];
+        kdst[p] = ksrc[i];
+      }
+    }
+    std::swap(src, dst);
+    std::swap(ksrc, kdst);
+  }
+  if (src != out) std::memcpy(out, src, n * sizeof(int32_t));
+}
+
+}  // namespace
+
+namespace {
+
+template <typename T>
+void gather(const T* src, const int32_t* order, int64_t n, T* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) out[i] = src[order[i]];
+}
+
+}  // namespace
+
+extern "C" {
+
+void wh_argsort_u32(const uint32_t* keys, int64_t n, int32_t* out) {
+  radix_argsort<uint32_t>(keys, n, out);
+}
+
+void wh_argsort_u64(const uint64_t* keys, int64_t n, int32_t* out) {
+  radix_argsort<uint64_t>(keys, n, out);
+}
+
+void wh_gather_32(const uint32_t* src, const int32_t* order, int64_t n,
+                  uint32_t* out) {
+  gather<uint32_t>(src, order, n, out);
+}
+
+void wh_gather_64(const uint64_t* src, const int32_t* order, int64_t n,
+                  uint64_t* out) {
+  gather<uint64_t>(src, order, n, out);
+}
+
+}  // extern "C"

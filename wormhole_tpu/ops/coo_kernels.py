@@ -110,10 +110,20 @@ def pack_sorted_coo(idx, seg, val, num_buckets: int,
     P = packed_size(capacity, num_buckets, TILE, BLK)
     nblk = P // BLK
 
-    order = np.argsort(idx, kind="stable")
-    sidx = np.asarray(idx, np.int32)[order]
-    sseg = np.asarray(seg, np.int32)[order]
-    sval = np.asarray(val, np.float32)[order]
+    from wormhole_tpu import native
+
+    order = native.radix_argsort(np.asarray(idx))
+    if order is None:
+        order = np.argsort(idx, kind="stable")
+
+    def take(a, dtype):
+        a = np.asarray(a, dtype)
+        got = native.gather(a, order)
+        return got if got is not None else a[order]
+
+    sidx = take(idx, np.int32)
+    sseg = take(seg, np.int32)
+    sval = take(val, np.float32)
     # padding entries in the input batch (val == 0) keep their slot; they
     # are harmless anywhere, so no special casing.
 

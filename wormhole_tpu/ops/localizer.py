@@ -28,14 +28,38 @@ class Localized:
 
 
 def localize(block_index: np.ndarray) -> Localized:
-    """Map raw keys to [0, n_uniq) (reference Localize, localizer.h:98-221)."""
+    """Map raw keys to [0, n_uniq) (reference Localize, localizer.h:98-221).
+
+    Sort + unique + remap, exactly the reference's parallel pipeline —
+    the sort rides the native radix core when available (the reference's
+    parallel_sort.h role), falling back to np.unique."""
     keys = np.ascontiguousarray(block_index, dtype=np.uint64)
-    uniq, inv, counts = np.unique(keys, return_inverse=True, return_counts=True)
-    return Localized(
-        uniq_keys=uniq,
-        counts=counts.astype(np.int32),
-        local_index=inv.astype(np.int32),
-    )
+    from wormhole_tpu import native
+
+    order = native.radix_argsort(keys)
+    if order is None:
+        uniq, inv, counts = np.unique(keys, return_inverse=True,
+                                      return_counts=True)
+        return Localized(
+            uniq_keys=uniq,
+            counts=counts.astype(np.int32),
+            local_index=inv.astype(np.int32),
+        )
+    n = len(keys)
+    if n == 0:
+        return Localized(np.zeros(0, np.uint64), np.zeros(0, np.int32),
+                         np.zeros(0, np.int32))
+    sk = keys[order]
+    new = np.empty(n, bool)
+    new[0] = True
+    np.not_equal(sk[1:], sk[:-1], out=new[1:])
+    starts = np.flatnonzero(new)
+    uniq = sk[starts]
+    gid = (np.cumsum(new) - 1).astype(np.int32)
+    inv = np.empty(n, np.int32)
+    inv[order] = gid
+    counts = np.diff(np.append(starts, n)).astype(np.int32)
+    return Localized(uniq_keys=uniq, counts=counts, local_index=inv)
 
 
 def localize_block(blk: RowBlock) -> tuple[Localized, RowBlock]:

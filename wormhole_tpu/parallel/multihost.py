@@ -158,22 +158,29 @@ def global_coo_batch(bsh, db, rank: int, local_rows: int,
     return tuple(out)
 
 
-def global_scalar_sum(local_value: int) -> int:
-    """Sum of a per-process host integer over the global mesh (each
-    process's value is counted once, not per device)."""
+def _global_scalar(local_per_device: "np.ndarray", reduce_fn) -> int:
+    """Reduce a per-local-device int64 vector over every device of the
+    global mesh."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = jax.devices()
     mesh = Mesh(np.array(devs), ("i",))
     sh = NamedSharding(mesh, P("i"))
-    n_local = len(jax.local_devices())
-    per = np.zeros(n_local, np.int64)
-    per[0] = local_value
     garr = jax.make_array_from_process_local_data(
-        sh, per, global_shape=(len(devs),))
-    return int(jnp.sum(garr))
+        sh, local_per_device, global_shape=(len(devs),))
+    return int(reduce_fn(garr))
+
+
+def global_scalar_sum(local_value: int) -> int:
+    """Sum of a per-process host integer over the global mesh (each
+    process's value is counted once, not per device)."""
+    import jax
+    import jax.numpy as jnp
+
+    per = np.zeros(len(jax.local_devices()), np.int64)
+    per[0] = local_value
+    return _global_scalar(per, jnp.sum)
 
 
 def global_scalar_max(local_value: int) -> int:
@@ -181,12 +188,6 @@ def global_scalar_max(local_value: int) -> int:
     Allreduce<Max> of the reference BSP apps (lbfgs.cc:107-113)."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    devs = jax.devices()
-    mesh = Mesh(np.array(devs), ("i",))
-    sh = NamedSharding(mesh, P("i"))
     per = np.full(len(jax.local_devices()), local_value, np.int64)
-    garr = jax.make_array_from_process_local_data(
-        sh, per, global_shape=(len(devs),))
-    return int(jnp.max(garr))
+    return _global_scalar(per, jnp.max)

@@ -309,6 +309,12 @@ class Scheduler:
                     self._blobs = {}
                 self._blobs[req["key"]] = req["data"]
             return {"ok": True}
+        if op == "blob_del":
+            # consumed rendezvous payloads should not sit in scheduler
+            # memory for the job's lifetime
+            with self._lock:
+                getattr(self, "_blobs", {}).pop(req["key"], None)
+            return {"ok": True}
         if op == "blob_get":
             with self._lock:
                 data = getattr(self, "_blobs", {}).get(req["key"])
