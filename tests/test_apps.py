@@ -457,3 +457,58 @@ def test_global_mesh_gbdt_launch(tmp_path):
     assert abs(gm_auc - single["train"]["auc"]) < 0.03, (
         gm_auc, single["train"]["auc"])
     assert gm_auc > 0.9
+
+
+def test_global_mesh_difacto_launch(train_files, tmp_path):
+    """DiFacto over the multi-process global mesh: both table groups
+    live as replicated global arrays, the FM step runs as one SPMD
+    program with collective gradient aggregation, and the validation
+    logloss matches a single-process run."""
+    import re
+
+    conf_text = f"""
+train_data = "{train_files}/train-.*"
+val_data = "{train_files}/val.libsvm"
+model_out = {tmp_path}/gfm_model
+algo = ftrl
+dim = 4
+threshold = 2
+lambda_l1 = 0.5
+minibatch = 256
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = 2
+global_mesh = 1
+"""
+    conf = tmp_path / "gfm.conf"
+    conf.write_text(conf_text)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "2", "-s", "0", "--node-timeout", "10", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.difacto", str(conf)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = re.search(r"final val: logloss=([0-9.]+)", r.stdout)
+    assert m, r.stdout
+    gm_logloss = float(m.group(1))
+    assert os.path.exists(f"{tmp_path}/gfm_model.npz"), r.stdout
+
+    from wormhole_tpu.models.difacto import DifactoConfig, DifactoLearner
+    from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+
+    cfg = DifactoConfig(
+        train_data=f"{train_files}/train-.*",
+        val_data=f"{train_files}/val.libsvm",
+        algo="ftrl", dim=4, threshold=2, lambda_l1=0.5, minibatch=256,
+        num_buckets=16384, v_buckets=4096, max_data_pass=2)
+    res = MinibatchSolver(DifactoLearner(cfg), cfg, verbose=False).run()
+    single = res["val"].mean("logloss")
+    assert abs(gm_logloss - single) < 0.05, (gm_logloss, single, r.stdout)
+
+    import numpy as np
+
+    saved = dict(np.load(f"{tmp_path}/gfm_model.npz"))
+    for k in ("w", "z", "n", "cnt", "V", "nV"):
+        assert k in saved, sorted(saved)

@@ -161,7 +161,11 @@ def _global_train(cfg, env, make_learner, verbose, client) -> dict:
         return mh.global_coo_batch(bsh, db, rank, local_rows,
                                    cfg.minibatch, cfg.nnz_per_row)
 
+    train_fn, eval_fn = learner.global_step_protocol()
+    rng = __import__("jax").random.PRNGKey(0)
+
     def run_pass(pattern, train: bool, seed: int):
+        nonlocal rng
         prog_tot: dict = {}
 
         def batches():
@@ -180,10 +184,13 @@ def _global_train(cfg, env, make_learner, verbose, client) -> dict:
             blk = next(it, None)
             args = global_args(blk if blk is not None else empty)
             if train:
-                learner.store.state, prog = learner._train_step(
-                    learner.store.state, *args)
+                # identical key sequence on every rank keeps any
+                # stochastic pieces (e.g. difacto grad dropout) in SPMD
+                # agreement
+                rng, sub = __import__("jax").random.split(rng)
+                prog = train_fn(args, sub)
             else:
-                prog = learner._eval_step(learner.store.state, *args)
+                prog = eval_fn(args)
             prog = {k: float(v) for k, v in prog.items()}
             # nex is a GLOBAL sum (the batch mask is mesh-sharded): zero
             # means every rank drained. The decision must be THE SAME on

@@ -129,6 +129,15 @@ class _CombinedStore:
         if self.on_load is not None:
             self.on_load()
 
+    @property
+    def state(self):
+        """Merged read view over both table groups (do not assign into
+        it; use the sub-stores)."""
+        out = {}
+        for s in self.stores:
+            out.update(s.state)
+        return out
+
     def nnz(self, name="w"):
         for s in self.stores:
             if name in s.state:
@@ -505,6 +514,30 @@ class DifactoLearner:
                     + [j(label), j(mask)])
         return ([j(uniq_w)] + wparts + [j(uniq_v)] + vparts
                 + [j(label), j(mask)])
+
+    # -- global-mesh SPMD protocol (apps/_runner._global_train) ------------
+    def global_step_protocol(self):
+        """(train_fn, eval_fn) over (seg, idx, val, label, mask) GLOBAL
+        arrays; vidx derives on device. Both mutate learner state and
+        return a progress dict of device scalars."""
+        vb = self.cfg.vb
+
+        def train_fn(args, rng):
+            seg, idx, val, label, mask = args
+            vidx = idx % np.int32(vb)
+            self.store.state, self.vstore.state, prog = self._train_step(
+                self.store.state, self.vstore.state, seg, idx, vidx, val,
+                label, mask, rng)
+            return prog
+
+        def eval_fn(args):
+            seg, idx, val, label, mask = args
+            vidx = idx % np.int32(vb)
+            _, prog = self._fwd(self.store.state, self.vstore.state,
+                                seg, idx, vidx, val, label, mask)
+            return prog
+
+        return train_fn, eval_fn
 
     def _prepared(self, blk, train: bool):
         if isinstance(blk, RowBlock):

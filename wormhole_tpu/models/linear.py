@@ -357,6 +357,18 @@ class LinearLearner:
         if self._mesh_coo or not self.use_pallas or cfg.compact_cap == 0:
             self._compact_cap = 0
 
+    # -- global-mesh SPMD protocol (apps/_runner._global_train) ------------
+    def global_step_protocol(self):
+        def train_fn(args, rng):
+            self.store.state, prog = self._train_step(
+                self.store.state, *args)
+            return prog
+
+        def eval_fn(args):
+            return self._eval_step(self.store.state, *args)
+
+        return train_fn, eval_fn
+
     def derived_tables(self) -> dict:
         """Tables that are non-additive pure functions of additive ones,
         for server-side recomputation in the multi-process PS data plane

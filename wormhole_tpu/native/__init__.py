@@ -29,6 +29,20 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def _stale() -> bool:
+    """True when the built library predates any native source file — a
+    stale .so from an older checkout lacks newer symbols and must be
+    rebuilt rather than dlopened."""
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    srcdir = os.path.join(_DIR, "src")
+    for name in os.listdir(srcdir):
+        if os.path.getmtime(os.path.join(srcdir, name)) > so_m:
+            return True
+    return False
+
+
 def _build() -> bool:
     """Compile to a per-process temp name, then os.replace into place, so
     concurrent first-use builds (multi-process launches on a shared
@@ -83,7 +97,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) and not _build():
+        if _stale() and not _build():
             return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
@@ -166,7 +180,7 @@ def radix_argsort(keys):
         keys = keys.view(np.uint32)
         fn = lib.wh_argsort_u32
     elif keys.dtype == np.int64 and (n == 0 or keys.min() >= 0):
-        keys = keys.astype(np.uint64)
+        keys = keys.view(np.uint64)
         fn = lib.wh_argsort_u64
     else:
         return None

@@ -56,9 +56,22 @@ def global_batch(sharding, local_np: np.ndarray, global_rows: int):
 
 def load_replicated(store, arrays: dict) -> None:
     """Install host arrays into a store whose tables are replicated over
-    a multi-process mesh (every process supplies the full array)."""
+    a multi-process mesh (every process supplies the full array).
+    Combined stores (difacto's two table groups) route each table to the
+    sub-store that owns it."""
     import jax
 
+    subs = getattr(store, "stores", None)
+    if subs is not None:
+        known = set().union(*(s.state for s in subs))
+        unknown = set(arrays) - known
+        assert not unknown, f"unknown tables {sorted(unknown)}"
+        for s in subs:
+            own = {k: v for k, v in arrays.items() if k in s.state}
+            load_replicated(s, own)
+        if getattr(store, "on_load", None) is not None:
+            store.on_load()
+        return
     for k, v in arrays.items():
         assert k in store.state, f"unknown table {k}"
         sh = store.sharding(k)
