@@ -162,8 +162,6 @@ def test_malformed_input_raises_not_hangs(lib):
 def test_native_throughput_exceeds_python(lib):
     """The point of the native core: parsing is much faster than Python.
     Soft bound (3x) so CI noise can't flake it; typical is >30x."""
-    import time
-
     rng = np.random.default_rng(0)
     lines = []
     for i in range(20000):
@@ -171,14 +169,23 @@ def test_native_throughput_exceeds_python(lib):
         lines.append("1 " + " ".join(f"{f}:1" for f in feats))
     text = "\n".join(lines) + "\n"
 
-    t0 = time.perf_counter()
-    a = native.parse_text(text, "libsvm")
-    t_native = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    b = P.parse_libsvm(text)
-    t_py = time.perf_counter() - t0
+    # best-of-3 on each side: under a loaded CI box a single run can be
+    # descheduled mid-parse, which flaked the old single-shot comparison
+    t_native, a = min(
+        (_timed(lambda: native.parse_text(text, "libsvm")) for _ in range(3)),
+        key=lambda p: p[0])
+    t_py, b = min((_timed(lambda: P.parse_libsvm(text)) for _ in range(3)),
+                  key=lambda p: p[0])
     _assert_blocks_equal(a, b)
     assert t_native < t_py / 3, (t_native, t_py)
+
+
+def _timed(fn):
+    import time
+
+    t0 = time.perf_counter()
+    out = fn()
+    return time.perf_counter() - t0, out
 
 
 def test_radix_argsort_matches_numpy():
