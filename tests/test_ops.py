@@ -89,6 +89,22 @@ def test_auc_against_bruteforce():
         np.testing.assert_allclose(got, _auc_brute(y, s), rtol=1e-5)
 
 
+def test_auc_ties_and_mask_combined():
+    """Heavy integer-valued ties with masked rows interleaved — exercises
+    the tie-group averaging and the masked-rank shift together."""
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        y = (rng.random(50) > 0.5).astype(np.float32)
+        s = rng.integers(0, 5, 50).astype(np.float32)
+        mask = (rng.random(50) < 0.7).astype(np.float32)
+        keep = mask > 0
+        if y[keep].min(initial=1) == y[keep].max(initial=0):
+            continue  # need both classes among unmasked rows
+        got = float(M.auc(jnp.asarray(y), jnp.asarray(s), jnp.asarray(mask)))
+        np.testing.assert_allclose(got, _auc_brute(y[keep], s[keep]),
+                                   rtol=1e-5)
+
+
 def test_auc_respects_mask():
     y = np.array([1, 0, 1, 0, 1], np.float32)
     s = np.array([2.0, 1.0, 3.0, -1.0, -99.0], np.float32)
