@@ -120,11 +120,11 @@ def bench_linear(num_buckets, minibatch, steps=BENCH_STEPS):
         seg, idx, val, label, mask = synth_criteo_batch(
             rng, minibatch, num_buckets)
         if lrn.use_pallas and lrn.ensure_compact(idx):
-            uc = ck.pack_unique_coo(idx, seg, val, num_buckets,
-                                    lrn._compact_cap,
-                                    capacity=cfg.row_capacity)
-            batches.append(tuple(lrn._ucoo_args(uc, label, mask)))
-            step = lrn._ucoo_steps[0]
+            tc = ck.pack_tile_coo(idx, seg, val, num_buckets,
+                                  lrn._compact_cap,
+                                  capacity=cfg.row_capacity)
+            batches.append(tuple(lrn._tcoo_args(tc, label, mask)))
+            step = lrn._tcoo_steps[0]
         elif lrn.use_pallas:
             p = ck.pack_sorted_coo(idx, seg, val, num_buckets,
                                    capacity=cfg.row_capacity)

@@ -168,10 +168,11 @@ def test_prob_predict_is_sigmoid_of_margin(synth_file):
     assert ((probs > 0) & (probs < 1)).all()
 
 
-# ---------------------------------------------------- unique-key compaction
-def test_pack_unique_coo_roundtrip():
-    """pack_unique_coo maps (uniq, compact slot) back to the original
-    bucket ids exactly, and drops overflow nonzeros when the unique count
+# ------------------------------------------------ tile-aligned compaction
+def test_pack_tile_coo_roundtrip():
+    """pack_tile_coo maps (uniq, compact slot) back to the original
+    bucket ids exactly, keeps each touched tile's slot run contiguous and
+    block-aligned, and drops overflow nonzeros when the unique count
     exceeds u_cap."""
     from wormhole_tpu.ops import coo_kernels as ck
 
@@ -181,26 +182,33 @@ def test_pack_unique_coo_roundtrip():
     idx = rng.integers(0, nb, size=nnz).astype(np.int64)
     seg = rng.integers(0, 128, size=nnz).astype(np.int32)
     val = rng.normal(size=nnz).astype(np.float32)
-    uc = ck.pack_unique_coo(idx, seg, val, nb, u_cap=8 * ck.TILE,
-                            capacity=nnz)
-    assert uc.dropped_nnz == 0
-    live = uc.coo.val != 0
+    tc = ck.pack_tile_coo(idx, seg, val, nb, u_cap=16 * ck.TILE,
+                          capacity=nnz)
+    assert tc.dropped_nnz == 0
+    live = tc.coo.val != 0
     # reconstruct original bucket ids from compact slots
-    orig = uc.uniq[uc.coo.idx[live]]
+    orig = tc.uniq[tc.coo.idx[live]]
     np.testing.assert_array_equal(np.sort(orig), np.sort(idx[val != 0]))
+    # slot-run structure: every real slot's full-table tile matches the
+    # tmap_u entry of its block, and runs are sorted within a tile
+    real = tc.uniq != nb
+    slots = np.flatnonzero(real)
+    np.testing.assert_array_equal(
+        tc.uniq[real] // ck.TILE, tc.tmap_u[slots // ck.BLK_U])
+    assert tc.first_u.sum() == tc.last_u.sum() > 0
     # overflow: tiny u_cap drops nonzeros and reports them
-    uc2 = ck.pack_unique_coo(idx, seg, val, nb, u_cap=ck.TILE,
-                             capacity=nnz)
-    assert uc2.dropped_nnz > 0
-    assert (uc2.coo.val != 0).sum() + uc2.dropped_nnz == (val != 0).sum()
+    tc2 = ck.pack_tile_coo(idx, seg, val, nb, u_cap=ck.TILE,
+                           capacity=nnz)
+    assert tc2.dropped_nnz > 0
+    assert (tc2.coo.val != 0).sum() + tc2.dropped_nnz == (val != 0).sum()
 
 
-@pytest.mark.parametrize("algo", ["ftrl", "adagrad"])
+@pytest.mark.parametrize("algo", ["ftrl", "adagrad", "sgd"])
 def test_compacted_matches_xla(synth_file, algo):
-    """The unique-key-compacted (Localizer) path must train identically to
-    the dense XLA path: same per-pass metrics and same final table, while
-    touching only O(unique keys) state per step (reference per-key server
-    updates, async_sgd.h:160-175)."""
+    """The tile-compacted (Localizer + fused in-place update) path must
+    train identically to the dense XLA path: same per-pass metrics and
+    same final table, while streaming only touched tiles per step
+    (reference per-key server updates, async_sgd.h:160-180)."""
     from wormhole_tpu.ops import coo_kernels as ck
 
     def run(kernel, compact_cap):
@@ -213,12 +221,36 @@ def test_compacted_matches_xla(synth_file, algo):
 
     p_x, l_x = run("xla", 0)
     p_r, l_r = run("pallas", ck.TILE)
-    assert l_r._compact_cap == ck.TILE and l_r._ucoo_steps is not None
+    assert l_r._compact_cap == ck.TILE and l_r._tcoo_steps is not None
     assert abs(p_x["logloss"] - p_r["logloss"]) < 1e-3
     assert abs(p_x["auc"] - p_r["auc"]) < 1e-3
     w_x = l_x.store.to_numpy()["w"]
     w_r = l_r.store.to_numpy()["w"]
     np.testing.assert_allclose(w_x, w_r, rtol=1e-3, atol=1e-5)
+
+
+def test_compacted_quantized_push_matches_xla(synth_file):
+    """fixed_bytes=1 (global-absmax int8 filter) must agree between the
+    fused in-kernel quantize and parallel.kvstore.quantize_push — the
+    scale is computed over the whole compact gradient outside the kernel
+    exactly so this holds."""
+    from wormhole_tpu.ops import coo_kernels as ck
+
+    def run(kernel, compact_cap):
+        cfg = LinearConfig(minibatch=128, num_buckets=8 * ck.TILE,
+                           nnz_per_row=16, algo="ftrl", lr_eta=0.5,
+                           lambda_l1=0.5, fixed_bytes=1, kernel=kernel,
+                           compact_cap=compact_cap, kernel_dtype="f32")
+        lrn = LinearLearner(cfg, make_mesh(1, 1))
+        return _train_passes(lrn, synth_file, passes=2), lrn
+
+    p_x, l_x = run("xla", 0)
+    p_r, l_r = run("pallas", ck.TILE)
+    assert abs(p_x["logloss"] - p_r["logloss"]) < 1e-3
+    assert abs(p_x["auc"] - p_r["auc"]) < 1e-3
+    np.testing.assert_allclose(l_x.store.to_numpy()["w"],
+                               l_r.store.to_numpy()["w"],
+                               rtol=1e-4, atol=1e-6)
 
 
 def test_compacted_predict_and_eval(synth_file):

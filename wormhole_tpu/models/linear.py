@@ -98,15 +98,19 @@ class LinearConfig:
     # kernel = pallas (tiled MXU COO kernels, ops/coo_kernels.py) | xla
     # (segment ops) | auto (pallas on an unsharded-table TPU run, else xla)
     kernel: str = "auto"
-    # Unique-key compaction (the Localizer path, ops/localizer.py +
-    # coo_kernels.pack_unique_coo): gather only the minibatch's unique
-    # buckets into a compact table, run the kernels and the optimizer
-    # update there, scatter back — step cost O(unique keys) instead of
-    # O(num_buckets), the TPU analog of the reference updating only
-    # pushed keys (async_sgd.h:160-175). -1 = auto (sized from the first
-    # batch, engaged when the compact domain is well under the table
-    # size), 0 = off, >0 = explicit unique-key capacity (rounded up to a
-    # whole tile).
+    # Tile-aligned unique-key compaction (the Localizer path,
+    # ops/localizer.py + coo_kernels.pack_tile_coo): the minibatch's
+    # unique buckets get compact slots grouped by their home table tile;
+    # a Pallas kernel streams only the TOUCHED tiles to gather w
+    # (tile_gather), the COO kernels run over the compact domain, and the
+    # optimizer update happens inside a Pallas kernel that rewrites each
+    # touched tile in place (ops/fused_update.py) — no XLA element
+    # gathers or scatters of state at all. Step cost O(touched tiles +
+    # unique keys) instead of O(num_buckets): the TPU analog of the
+    # reference server updating only pushed keys at their storage
+    # (async_sgd.h:160-180). -1 = auto (sized from the first batch,
+    # engaged when the compact domain is well under the table size),
+    # 0 = off, >0 = explicit slot capacity (rounded up to a whole tile).
     compact_cap: int = -1
     # MXU compute dtype for the pallas kernels: bf16 (half the MXU cost;
     # table values and per-nnz gradients round to bfloat16) | f32 (exact,
@@ -352,7 +356,7 @@ class LinearLearner:
         # is known (auto mode sizes it from the first batch); the lock
         # serializes the decide+build against concurrent loader threads
         self._compact_cap: Optional[int] = None
-        self._ucoo_steps = None
+        self._tcoo_steps = None
         self._compact_lock = threading.Lock()
         if self._mesh_coo or not self.use_pallas or cfg.compact_cap == 0:
             self._compact_cap = 0
@@ -389,91 +393,77 @@ class LinearLearner:
             if self._compact_cap is None:
                 cap = self._decide_compact_cap(idx)
                 if cap:
-                    self._build_ucoo(cap)
+                    self._build_tcoo(cap)
                 # publish the cap only after the steps exist, so a racing
                 # reader can never see cap set but steps still None
                 self._compact_cap = cap
         return self._compact_cap
 
     def _decide_compact_cap(self, idx) -> int:
-        """Pick the compact capacity from the first batch: 1.5x headroom
-        over its unique-bucket count rounded to whole tiles (batches draw
-        from the same key distribution, and overflow falls back to
-        drop-and-warn), engaged only when the compact domain is at most a
-        quarter of the table (otherwise dense tiles are already cheaper)."""
+        """Pick the compact slot capacity from the first batch: 1.5x
+        headroom in update blocks over what the batch needs (batches draw
+        from the same key distribution; overflow falls back to
+        drop-and-warn), rounded to whole tiles. Engaged only when the
+        compact domain is well under the table size — otherwise the dense
+        path's per-tile padding is already cheaper than the extra
+        tile_gather / scatter_update streaming (constant measured on
+        v5e)."""
         cfg = self.cfg
         if cfg.compact_cap > 0:
             return -(-cfg.compact_cap // ck.TILE) * ck.TILE
-        u = len(np.unique(np.asarray(idx, np.int64)))
-        cand = -(-int(1.5 * u) // ck.TILE) * ck.TILE
-        # Cost model (measured on v5e): compaction removes the
-        # one-block-per-tile kernel padding (~30us per TILE of table) but
-        # adds elementwise gather/scatter of ~cand entries per state table
-        # (~60ns per unique key). Engage when the saved tile blocks
-        # outweigh the transfers — around num_buckets >= 128 * cand.
-        if cfg.num_buckets >= 128 * cand:
+        ids = np.unique(np.asarray(idx, np.int64))
+        n_t = np.bincount(ids // ck.TILE)
+        blocks = int(np.sum(-(-n_t[n_t > 0] // ck.BLK_U)))
+        cand = -(-int(1.5 * blocks) * ck.BLK_U // ck.TILE) * ck.TILE
+        if cfg.num_buckets >= 32 * cand:
             return cand
         return 0
 
-    def _build_ucoo(self, U: int):
+    def _build_tcoo(self, U: int):
         cfg = self.cfg
+        from wormhole_tpu.ops.fused_update import scatter_update
 
         @partial(jax.jit, donate_argnums=0)
-        def train_step_ucoo(state, uniq, sidx, sseg, sval, tmap, first,
-                            label, mask):
-            if cfg.algo == "ftrl":
-                # FTRL's w is a pure function of (z, n) — the invariant
-                # w == l1l2_solve(-z, eta(n)) holds at init (all zeros)
-                # and after every touched update — so skip gathering the
-                # w table and derive the compact copy instead: one fewer
-                # random gather per step.
-                cstate = {k: jnp.take(state[k], uniq, mode="clip")
-                          for k in ("z", "n")}
-                eta = (cfg.lr_beta + jnp.sqrt(cstate["n"])) / cfg.lr_eta
-                cstate["w"] = l1l2_solve(-cstate["z"], eta,
-                                         cfg.lambda_l1, cfg.lambda_l2)
-            else:
-                cstate = {k: jnp.take(v, uniq, mode="clip")
-                          for k, v in state.items()}
-            xw = ck.coo_spmv(cstate["w"], sidx, sseg, sval, tmap, first,
+        def train_step_tcoo(state, uniq, tmap_u, first_u, last_u,
+                            sidx, sseg, sval, tmap, first, label, mask):
+            w2 = state["w"].reshape(-1, ck.LANES)
+            wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
+            xw = ck.coo_spmv(wc, sidx, sseg, sval, tmap, first,
                              cfg.minibatch, dtype=self._coo_dtype)
             obj, d = _loss_dual(cfg.loss, label, xw)
             d = d * mask
             g = ck.coo_spmv_t(d, sidx, sseg, sval, tmap, first, U,
                               dtype=self._coo_dtype)
-            raw_g = g
-            g = quantize_push(g, cfg.fixed_bytes)
-            if cfg.algo == "ftrl":
-                # FTRL is a no-op where g == 0 (w is a pure function of
-                # (z, n)), so clamp-gathered padding slots round-trip
-                # unchanged and their dropped scatters lose nothing
-                touched = 1.0
-            else:
-                touched = (raw_g != 0).astype(jnp.float32)
-            new_c = _update(cfg.algo, cstate, g, touched, cfg)
-            new_w = (jnp.sum(new_c["w"] != 0)
-                     - jnp.sum(cstate["w"] != 0)).astype(jnp.float32)
-            out = {k: state[k].at[uniq].set(new_c[k], mode="drop")
-                   for k in state}
-            return out, _progress(obj, xw, label, mask, new_w)
+            # the scatter, quantization filter, touched masking, and the
+            # per-key handle update all happen inside the fused kernel,
+            # in place on the touched tiles
+            new_state, new_w = scatter_update(
+                cfg.algo, state, g, uniq, tmap_u, first_u, last_u,
+                lr_eta=cfg.lr_eta, lr_beta=cfg.lr_beta,
+                lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+                fixed_bytes=cfg.fixed_bytes, dtype=self._coo_dtype)
+            return new_state, _progress(obj, xw, label, mask, new_w)
 
         @jax.jit
-        def eval_step_ucoo(state, uniq, sidx, sseg, sval, tmap, first,
-                           label, mask):
-            wc = jnp.take(state["w"], uniq, mode="clip")
+        def eval_step_tcoo(state, uniq, tmap_u, first_u, last_u,
+                           sidx, sseg, sval, tmap, first, label, mask):
+            w2 = state["w"].reshape(-1, ck.LANES)
+            wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
             xw = ck.coo_spmv(wc, sidx, sseg, sval, tmap, first,
                              cfg.minibatch, dtype=self._coo_dtype)
             obj, _ = _loss_dual(cfg.loss, label, xw)
             return _progress(obj, xw, label, mask)
 
         @jax.jit
-        def predict_step_ucoo(state, uniq, sidx, sseg, sval, tmap, first):
-            wc = jnp.take(state["w"], uniq, mode="clip")
+        def predict_step_tcoo(state, uniq, tmap_u, first_u, last_u,
+                              sidx, sseg, sval, tmap, first):
+            w2 = state["w"].reshape(-1, ck.LANES)
+            wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
             return ck.coo_spmv(wc, sidx, sseg, sval, tmap, first,
                                cfg.minibatch, dtype=self._coo_dtype)
 
-        self._ucoo_steps = (train_step_ucoo, eval_step_ucoo,
-                            predict_step_ucoo)
+        self._tcoo_steps = (train_step_tcoo, eval_step_tcoo,
+                            predict_step_tcoo)
 
     # -- device batch plumbing ---------------------------------------------
     def _shard(self, *arrays):
@@ -516,17 +506,17 @@ class LinearLearner:
                     "nnz_per_row or mesh_capacity slack", mc.dropped_nnz)
             return ("mcoo", mc, db.label, db.row_mask, blk.size)
         if self.ensure_compact(db.idx):
-            uc = ck.pack_unique_coo(db.idx, db.seg, db.val,
-                                    self.cfg.num_buckets, self._compact_cap,
-                                    capacity=self.cfg.row_capacity)
-            if uc.dropped_nnz:
+            tc = ck.pack_tile_coo(db.idx, db.seg, db.val,
+                                  self.cfg.num_buckets, self._compact_cap,
+                                  capacity=self.cfg.row_capacity)
+            if tc.dropped_nnz:
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "compaction overflow: dropped %d nonzeros — raise "
-                    "compact_cap (currently %d)",
-                    uc.dropped_nnz, self._compact_cap)
-            return ("ucoo", uc, db.label, db.row_mask, blk.size)
+                    "compaction overflow: dropped %d unique keys "
+                    "(%d nonzeros) — raise compact_cap (currently %d)",
+                    tc.dropped_uniq, tc.dropped_nnz, self._compact_cap)
+            return ("tcoo", tc, db.label, db.row_mask, blk.size)
         p = ck.pack_sorted_coo(db.idx, db.seg, db.val, self.cfg.num_buckets,
                                capacity=self.cfg.row_capacity)
         return ("coo", p, db.label, db.row_mask, blk.size)
@@ -542,10 +532,10 @@ class LinearLearner:
             _, mc, label, mask, _ = b
             self.store.state, prog = self._train_step_mcoo(
                 self.store.state, *self._mcoo_args(mc, label, mask))
-        elif b[0] == "ucoo":
-            _, uc, label, mask, _ = b
-            self.store.state, prog = self._ucoo_steps[0](
-                self.store.state, *self._ucoo_args(uc, label, mask))
+        elif b[0] == "tcoo":
+            _, tc, label, mask, _ = b
+            self.store.state, prog = self._tcoo_steps[0](
+                self.store.state, *self._tcoo_args(tc, label, mask))
         elif b[0] == "coo":
             _, p, label, mask, _ = b
             self.store.state, prog = self._train_step_coo(
@@ -563,10 +553,10 @@ class LinearLearner:
             _, mc, label, mask, _ = b
             prog = self._eval_step_mcoo(
                 self.store.state, *self._mcoo_args(mc, label, mask))
-        elif b[0] == "ucoo":
-            _, uc, label, mask, _ = b
-            prog = self._ucoo_steps[1](
-                self.store.state, *self._ucoo_args(uc, label, mask))
+        elif b[0] == "tcoo":
+            _, tc, label, mask, _ = b
+            prog = self._tcoo_steps[1](
+                self.store.state, *self._tcoo_args(tc, label, mask))
         elif b[0] == "coo":
             _, p, label, mask, _ = b
             prog = self._eval_step_coo(
@@ -584,10 +574,10 @@ class LinearLearner:
             _, mc, _, _, size = b
             xw = self._predict_step_mcoo(
                 self.store.state, *self._mcoo_args(mc))
-        elif b[0] == "ucoo":
-            _, uc, _, _, size = b
-            xw = self._ucoo_steps[2](
-                self.store.state, *self._ucoo_args(uc))
+        elif b[0] == "tcoo":
+            _, tc, _, _, size = b
+            xw = self._tcoo_steps[2](
+                self.store.state, *self._tcoo_args(tc))
         elif b[0] == "coo":
             _, p, _, _, size = b
             xw = self._predict_step_coo(
@@ -601,10 +591,11 @@ class LinearLearner:
             out = 1.0 / (1.0 + np.exp(-out))
         return out
 
-    def _ucoo_args(self, uc, label=None, mask=None):
-        p = uc.coo
-        args = [jnp.asarray(uc.uniq), jnp.asarray(p.idx),
-                jnp.asarray(p.seg), jnp.asarray(p.val),
+    def _tcoo_args(self, tc, label=None, mask=None):
+        p = tc.coo
+        args = [jnp.asarray(tc.uniq), jnp.asarray(tc.tmap_u),
+                jnp.asarray(tc.first_u), jnp.asarray(tc.last_u),
+                jnp.asarray(p.idx), jnp.asarray(p.seg), jnp.asarray(p.val),
                 jnp.asarray(p.tmap), jnp.asarray(p.first)]
         if label is not None:
             args += [jnp.asarray(label), jnp.asarray(mask)]

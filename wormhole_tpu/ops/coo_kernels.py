@@ -156,6 +156,15 @@ def pack_sorted_coo(idx, seg, val, num_buckets: int,
     return SortedCOO(out_idx, out_seg, out_val, tmap, first)
 
 
+def _prec(dtype):
+    """MXU precision for the kernel matmuls: at f32 request HIGHEST
+    (bf16x3 decomposition) so the "exact" kernel_dtype=f32 path really
+    matches the XLA segment-op numerics — the default single-pass mode
+    rounds f32 operands to bf16 on the way into the systolic array."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32 else
+            jax.lax.Precision.DEFAULT)
+
+
 def _row_fetch(table2, hi, dtype):
     """table2: (R, 128); hi: (BLK,) row ids in [0, R). Returns (BLK, 128)
     f32: row hi[j] of table2 in row j — a one-hot MXU matmul (Mosaic's
@@ -166,6 +175,7 @@ def _row_fetch(table2, hi, dtype):
         e, table2.astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_prec(dtype),
     )
 
 
@@ -221,6 +231,7 @@ def _pull_kernel(tmap_ref, first_ref, w_ref, idx_ref, seg_ref, val_ref,
         e_rt, (p[:, None] * c_r).astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_prec(dtype),
     )
 
 
@@ -280,6 +291,7 @@ def _push_kernel(tmap_ref, first_ref, d_ref, idx_ref, seg_ref, val_ref,
         e_hit, (c[:, None] * c_lo).astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_prec(dtype),
     )
 
 
@@ -316,41 +328,57 @@ def coo_spmv_t(d, sidx, sseg, sval, tmap, first, num_buckets: int,
     return out.reshape(num_buckets)
 
 
-# --------------------------------------------------- unique-key compaction
+# ------------------------------------------- tile-aligned compaction
 # At Criteo-1TB table sizes (>=2^26 buckets) a minibatch touches a tiny,
-# hash-spread fraction of the table: ~60k unique buckets scattered across
-# all of it. Processing the table densely (one padding block per tile
-# above, plus an O(num_buckets) optimizer sweep) then scales with the
-# table, not the batch — the exact failure the reference avoids by
+# hash-spread fraction of the table: ~160k unique buckets scattered
+# across all of it. Processing the table densely (one padding block per
+# tile above, plus an O(num_buckets) optimizer sweep) then scales with
+# the table, not the batch — the exact failure the reference avoids by
 # updating only pushed keys on its servers (async_sgd.h:160-175). The
 # compacted path is the TPU analog of the reference Localizer
-# (learn/base/localizer.h:42-221): map the batch's unique bucket ids to a
-# dense [0, u_cap) slot space, gather those entries of the state tables
-# into a compact table, run the SAME kernels over the compact domain
-# (whose tile count is ~nnz/TILE instead of num_buckets/TILE), update
-# there, and scatter the entries back. Step cost becomes O(unique keys),
-# ~flat in table size — ZPull/ZPush of exactly the minibatch's keys
-# (async_sgd.h:277-287).
+# (learn/base/localizer.h:42-221): map the batch's unique bucket ids to
+# a compact [0, u_cap) slot space and run the SAME kernels over the
+# compact domain (whose tile count is ~uniques/TILE instead of
+# num_buckets/TILE). A plain dense slot assignment would still pay XLA
+# element gather/scatter of the compact entries (~20 ns per random
+# access — latency-bound, ~22 ms per 64k-row step at 2^26 buckets), so
+# slots are instead grouped so each TOUCHED full-table tile's unique
+# keys occupy a BLK_U-aligned contiguous slot run. Then
+# - pulling the touched entries is a Pallas kernel streaming only the
+#   touched table tiles (tile_gather below), and
+# - the optimizer update runs INSIDE a Pallas kernel that scatters the
+#   compact gradient into each touched tile and rewrites the tile in
+#   place (ops/fused_update.py, aliased in/out) — the TPU analog of the
+#   reference server handle updating the entry at its storage on push
+#   (async_sgd.h:160-180), with untouched tiles never streamed at all.
+
+# slots per update block; 1024 is the minimum 1D block Mosaic accepts
+# against XLA's s32[...]{0:T(1024)} layout for large 1D operands
+BLK_U = int(os.environ.get("WORMHOLE_BLK_U", 1024))
+assert TILE % BLK_U == 0, "BLK_U must divide TILE (block map alignment)"
+
 
 
 @dataclasses.dataclass
-class UniqueCOO:
-    """A minibatch packed over the unique-key-compacted domain."""
+class TileCOO:
+    """A minibatch localized into a tile-aligned compact slot space."""
 
-    uniq: np.ndarray   # (u_cap,) int32 unique bucket ids, sorted; padding
-    #                    = num_buckets (out of bounds: gathers clamp
-    #                    harmlessly, scatters drop)
-    coo: SortedCOO     # packed over the compact domain [0, u_cap)
-    num_uniq: int      # how many entries of `uniq` are real
-    dropped_nnz: int   # nonzeros dropped because uniques overflowed u_cap
+    uniq: np.ndarray    # (u_cap,) int32 full-table ids per slot, sorted;
+    #                     sentinel num_buckets in alignment holes
+    coo: SortedCOO      # the batch packed over the compact domain
+    tmap_u: np.ndarray  # (u_cap/BLK_U,) int32 full-table tile per block
+    first_u: np.ndarray  # (u_cap/BLK_U,) 1 iff block starts its tile's run
+    last_u: np.ndarray  # (u_cap/BLK_U,) 1 iff block ends its tile's run
+    num_uniq: int
+    dropped_uniq: int   # unique keys cut on u_cap overflow
+    dropped_nnz: int    # their nonzeros, dropped with them
 
 
-def pack_unique_coo(idx, seg, val, num_buckets: int, u_cap: int,
-                    capacity: int | None = None) -> UniqueCOO:
-    """Localize the batch's bucket ids (ops/localizer.py — the reference
-    Localizer's sort+unique+remap) and pack the COO triples over the
-    compact domain (host-side, loader threads — the reference runs its
-    Localizer there too)."""
+def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
+                  capacity: int | None = None) -> TileCOO:
+    """Localize bucket ids (the reference Localizer's sort+unique+remap,
+    localizer.h:98-221) into tile-run-aligned compact slots and pack the
+    COO triples over that domain (host-side, loader threads)."""
     assert u_cap % TILE == 0, f"u_cap must be a multiple of {TILE}"
     assert num_buckets < 2**31, "sentinel id must fit int32"
     from wormhole_tpu.ops.localizer import localize
@@ -359,18 +387,103 @@ def pack_unique_coo(idx, seg, val, num_buckets: int, u_cap: int,
     seg = np.asarray(seg, np.int32)
     val = np.asarray(val, np.float32)
     loc = localize(idx.astype(np.uint64))
-    uniq = loc.uniq_keys.astype(np.int64)
-    slot = loc.local_index
-    dropped = 0
-    if len(uniq) > u_cap:
-        keep = slot < u_cap
-        dropped = int(np.count_nonzero(~keep))
-        seg, val, slot = seg[keep], val[keep], slot[keep]
-        uniq = uniq[:u_cap]
+    uniq = loc.uniq_keys.astype(np.int64)          # sorted
+    inv = loc.local_index                          # nnz -> rank in uniq
+    nb = u_cap // BLK_U
+
+    tile_of = (uniq // TILE).astype(np.int64)
+    t_ids, n_t = np.unique(tile_of, return_counts=True)
+    b_t = np.maximum((n_t + BLK_U - 1) // BLK_U, 1)
+    # cap: keep whole tiles (and a truncated final tile) within nb blocks
+    cum_b = np.cumsum(b_t)
+    n_keep_tiles = int(np.searchsorted(cum_b, nb, side="right"))
+    dropped_uniq = 0
+    if n_keep_tiles < len(t_ids):
+        # truncate the boundary tile to the blocks that still fit
+        blocks_left = nb - (cum_b[n_keep_tiles - 1] if n_keep_tiles else 0)
+        if blocks_left > 0:
+            b_t[n_keep_tiles] = blocks_left
+            n_t[n_keep_tiles] = min(n_t[n_keep_tiles],
+                                    blocks_left * BLK_U)
+            n_keep_tiles += 1
+        kept_uniq = int(np.sum(n_t[:n_keep_tiles]))
+        dropped_uniq = len(uniq) - kept_uniq
+        t_ids, n_t, b_t = (t_ids[:n_keep_tiles], n_t[:n_keep_tiles],
+                           b_t[:n_keep_tiles])
+    else:
+        kept_uniq = len(uniq)
+
+    # slot of each kept unique = its tile's aligned base + rank in tile
+    dst_base = np.concatenate([[0], np.cumsum(b_t)[:-1]]) * BLK_U
+    src_base = np.concatenate([[0], np.cumsum(n_t)[:-1]])
+    rank = np.arange(len(uniq), dtype=np.int64)
+    tile_rank = np.searchsorted(t_ids, tile_of[:kept_uniq])
+    slot_of_uniq = np.full(len(uniq), u_cap, np.int64)  # dropped -> u_cap
+    slot_of_uniq[:kept_uniq] = (dst_base[tile_rank]
+                                + rank[:kept_uniq] - src_base[tile_rank])
+
     out_uniq = np.full(u_cap, num_buckets, np.int32)
-    out_uniq[: len(uniq)] = uniq
-    p = pack_sorted_coo(slot, seg, val, u_cap, capacity=capacity)
-    return UniqueCOO(out_uniq, p, len(uniq), dropped)
+    out_uniq[slot_of_uniq[:kept_uniq]] = uniq[:kept_uniq]
+
+    tmap_u = np.zeros(nb, np.int32)
+    first_u = np.zeros(nb, np.int32)
+    last_u = np.zeros(nb, np.int32)
+    used = int(np.sum(b_t))
+    tmap_u[:used] = np.repeat(t_ids, b_t)
+    if used:
+        tmap_u[used:] = t_ids[-1]  # trailing spare blocks: inert revisits
+        ends = np.cumsum(b_t)
+        first_u[ends - b_t] = 1
+        last_u[ends - 1] = 1
+    else:  # degenerate empty batch: one harmless copy-through of tile 0
+        first_u[0] = 1
+        last_u[0] = 1
+
+    new_slot = slot_of_uniq[inv]
+    keep = new_slot < u_cap
+    dropped_nnz = int(np.count_nonzero(~keep))
+    p = pack_sorted_coo(new_slot[keep], seg[keep], val[keep], u_cap,
+                        capacity=capacity)
+    return TileCOO(out_uniq, p, tmap_u, first_u, last_u, kept_uniq,
+                   dropped_uniq, dropped_nnz)
+
+
+def _tile_gather_kernel(tmap_ref, w_ref, uniq_ref, out_ref, *, dtype):
+    base = tmap_ref[pl.program_id(0)] * TILE
+    local = uniq_ref[:] - base
+    hi = local >> 7
+    lo = local & (LANES - 1)
+    # sentinel slots (uniq == num_buckets) produce hi outside [0, TILE_HI):
+    # their one-hot row is all zeros, so they fetch 0.0 — no clamp needed
+    c_lo = _onehot(lo, LANES, dtype)
+    out_ref[:] = _lane_pick(_row_fetch(w_ref[:], hi, dtype), c_lo)
+
+
+def tile_gather(table2, uniq, tmap_u, dtype=None):
+    """Gather table entries at the tile-aligned compact slots: returns
+    (u_cap,) f32 with out[s] = table[uniq[s]] (0.0 at sentinel holes).
+    table2 is the table viewed (num_buckets//128, 128); only TOUCHED
+    tiles are streamed — the whole point vs an XLA gather, whose per-
+    element random-access latency (~20ns) dwarfs the tile bandwidth."""
+    if dtype is None:
+        dtype = jnp.bfloat16 if not _use_interpret() else jnp.float32
+    nb = tmap_u.shape[0]
+    u_cap = nb * BLK_U
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((TILE_HI, LANES), lambda b, tmap: (tmap[b], 0)),
+            pl.BlockSpec((BLK_U,), lambda b, *_: (b,)),
+        ],
+        out_specs=pl.BlockSpec((BLK_U,), lambda b, *_: (b,)),
+    )
+    return pl.pallas_call(
+        partial(_tile_gather_kernel, dtype=dtype),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((u_cap,), jnp.float32),
+        interpret=_use_interpret(),
+    )(tmap_u, table2, uniq)
 
 
 # ------------------------------------------------------------ FM / SpMM
@@ -402,6 +515,7 @@ def _fm_pull_kernel(tmap_ref, first_ref, V_ref, idx_ref, seg_ref, val_ref,
         e, V_ref[:].astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_prec(dtype),
     )                                            # [BLK, dim]
     p = val_ref[:][:, None] * rows
     p2 = p * p                                   # (val V)^2 = val^2 V^2
@@ -418,11 +532,13 @@ def _fm_pull_kernel(tmap_ref, first_ref, V_ref, idx_ref, seg_ref, val_ref,
             e_rt, (p_k * c_r).astype(dtype),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(dtype),
         )
         out_refs[dim + k][:] += jax.lax.dot_general(
             e_rt, (p2_k * c_r).astype(dtype),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(dtype),
         )
 
 
@@ -484,6 +600,7 @@ def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, *rest,
         e, V_ref[:].astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_prec(dtype),
     )                                             # [BLK, dim]
     rhi = seg_ref[:] >> 7
     rlo = seg_ref[:] & (LANES - 1)
@@ -505,6 +622,7 @@ def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, *rest,
                 e_rc, xv_refs[k][:].astype(dtype),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_prec(dtype),
             )                                     # [ch, 128]
             ys.append(_lane_pick(t_k, c_rlo_c))
         y_chunks.append(jnp.stack(ys, axis=1))
@@ -517,6 +635,7 @@ def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, *rest,
         e_t, contrib.astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_prec(dtype),
     )
 
 

@@ -1,0 +1,196 @@
+"""Fused scatter + optimizer update over touched table tiles, in place.
+
+The reference's server applies the update rule AT the key's storage when
+a push arrives (learn/linear/async_sgd.h:160-180: FTRLHandle::Push
+mutates the entry in the server's map). The TPU analog here: one Pallas
+kernel walks the batch's TOUCHED table tiles (the tile-aligned compact
+layout of ops/coo_kernels.pack_tile_coo), scatters the compact gradient
+into each tile with an MXU one-hot matmul, applies the FTRL / AdaGrad /
+SGD handle math to the whole (512, 128) tile, and writes the tile back
+through aliased in/out buffers — so a training step performs NO XLA
+element gathers or scatters of optimizer state at all, and untouched
+tiles are never streamed.
+
+Semantics match models/linear._update exactly:
+- FTRL: w is a pure function of (z, n); entries with zero gradient
+  round-trip unchanged, so updating the whole tile is a no-op exactly
+  where the reference would not receive a push.
+- AdaGrad/SGD: repeated L1 shrinkage must only hit pushed keys, so the
+  tile update is masked by g != 0 (the touched mask).
+- fixed_bytes: the push-quantization filter applies to the scattered
+  gradient before the update; the int8 mode's absmax scale is computed
+  over the WHOLE compact gradient outside the kernel and passed in, so
+  numerics match parallel.kvstore.quantize_push bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+from wormhole_tpu.ops.coo_kernels import (BLK_U, LANES, TILE, TILE_HI,
+                                          _onehot, _onehot_t, _prec,
+                                          _use_interpret)
+from wormhole_tpu.ops.penalty import l1l2_solve
+
+
+def _quantize(g, fixed_bytes: int, qscale):
+    """In-kernel mirror of parallel.kvstore.quantize_push: bf16 rounding
+    for fixed_bytes >= 2, global-absmax int8 for fixed_bytes == 1."""
+    if fixed_bytes == 0:
+        return g
+    if fixed_bytes >= 2:
+        return g.astype(jnp.bfloat16).astype(g.dtype)
+    q = jnp.clip(jnp.round(g / qscale), -127, 127)
+    # round-trip through int8 like quantize_push (values already integral)
+    return q * qscale
+
+
+def _apply(algo: str, z, n, w, g, touched, *, lr_eta, lr_beta,
+           lambda_l1, lambda_l2):
+    """The per-entry handle math of models/linear._update, on a tile."""
+    if algo == "ftrl":
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr_eta
+        z2 = z + touched * (g - sigma * w)
+        n2 = n + touched * g * g
+        eta = (lr_beta + jnp.sqrt(n2)) / lr_eta
+        w2 = l1l2_solve(-z2, eta, lambda_l1, lambda_l2)
+        w2 = jnp.where(touched > 0, w2, w)
+        return z2, n2, w2
+    if algo == "adagrad":
+        n2 = n + touched * g * g
+        eta = (lr_beta + jnp.sqrt(n2)) / lr_eta
+        w2 = l1l2_solve(eta * w - g, eta, lambda_l1, lambda_l2)
+        w2 = jnp.where(touched > 0, w2, w)
+        return None, n2, w2
+    if algo == "sgd":
+        eta = 1.0 / lr_eta
+        w2 = l1l2_solve(eta * w - g, eta, lambda_l1, lambda_l2)
+        w2 = jnp.where(touched > 0, w2, w)
+        return None, None, w2
+    raise ValueError(f"unknown algo {algo!r}")
+
+
+def _kernel(tmap_ref, first_ref, last_ref, qscale_ref, g_ref, uniq_ref,
+            *refs, algo: str, dtype, fixed_bytes: int, hyper: dict):
+    # refs = state-in tiles, then state-out tiles (same count), then
+    # nw_out, then the g_acc scratch
+    n_tabs = (len(refs) - 2) // 2
+    in_refs = refs[:n_tabs]
+    out_refs = refs[n_tabs:2 * n_tabs]
+    nw_ref = refs[2 * n_tabs]
+    acc_ref = refs[2 * n_tabs + 1]
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        nw_ref[:] = jnp.zeros_like(nw_ref)
+
+    @pl.when(first_ref[b] == 1)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        # copy-through so a partially-visited tile flushes its original
+        # values, never uninitialized VMEM
+        for i_ref, o_ref in zip(in_refs, out_refs):
+            o_ref[:] = i_ref[:]
+
+    base = tmap_ref[b] * TILE
+    local = uniq_ref[:] - base
+    hi = local >> 7
+    lo = local & (LANES - 1)
+    # sentinel slots (uniq == num_buckets) fall outside [0, TILE_HI) and
+    # contribute all-zero one-hot rows — they scatter nothing
+    e_t = _onehot_t(hi, TILE_HI, dtype)
+    c_lo = _onehot(lo, LANES, dtype)
+    acc_ref[:] += jax.lax.dot_general(
+        e_t, (g_ref[:][:, None] * c_lo).astype(dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_prec(dtype),
+    )
+
+    @pl.when(last_ref[b] == 1)
+    def _():
+        raw_g = acc_ref[:]
+        g = _quantize(raw_g, fixed_bytes, qscale_ref[0])
+        if algo == "ftrl":
+            touched = 1.0
+            z, n, w = in_refs[0][:], in_refs[1][:], in_refs[2][:]
+        else:
+            touched = (raw_g != 0).astype(jnp.float32)
+            z = None
+            n = in_refs[0][:] if algo == "adagrad" else None
+            w = in_refs[-1][:]
+        w_old = w if algo != "ftrl" else in_refs[2][:]
+        z2, n2, w2 = _apply(algo, z, n, w, g, touched, **hyper)
+        outs = {"ftrl": (z2, n2, w2), "adagrad": (n2, w2),
+                "sgd": (w2,)}[algo]
+        for o_ref, v in zip(out_refs, outs):
+            o_ref[:] = v
+        delta = (jnp.sum((w2 != 0).astype(jnp.float32))
+                 - jnp.sum((w_old != 0).astype(jnp.float32)))
+        nw_ref[:] += delta
+
+
+def scatter_update(algo: str, state: dict, g, uniq, tmap_u, first_u,
+                   last_u, *, lr_eta, lr_beta, lambda_l1, lambda_l2,
+                   fixed_bytes: int = 0, dtype=None):
+    """Apply the algo's handle update to the touched tiles of the state
+    tables, in place (aliased), driven by the tile-aligned compact
+    gradient g. Returns (new_state, new_w) where new_w is the |w|_0
+    delta of this step (reference progress.h new_w accounting).
+
+    state holds flat (num_buckets,) tables: ftrl {w,z,n}, adagrad {w,n},
+    sgd {w}. g/uniq are (u_cap,) from coo_spmv_t / pack_tile_coo."""
+    if dtype is None:
+        dtype = jnp.bfloat16 if not _use_interpret() else jnp.float32
+    order = {"ftrl": ("z", "n", "w"), "adagrad": ("n", "w"),
+             "sgd": ("w",)}[algo]
+    tabs = [state[k].reshape(-1, LANES) for k in order]
+    nb = tmap_u.shape[0]
+    num_buckets = tabs[0].shape[0] * LANES
+    if fixed_bytes == 1:
+        qscale = (jnp.maximum(jnp.max(jnp.abs(g)), 1e-12) / 127.0)[None]
+    else:
+        qscale = jnp.ones((1,), jnp.float32)
+    hyper = dict(lr_eta=lr_eta, lr_beta=lr_beta, lambda_l1=lambda_l1,
+                 lambda_l2=lambda_l2)
+
+    def tile_map(b, tmap, first, last, qs):
+        return (tmap[b], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((BLK_U,), lambda b, *_: (b,)),   # g
+            pl.BlockSpec((BLK_U,), lambda b, *_: (b,)),   # uniq
+        ] + [pl.BlockSpec((TILE_HI, LANES), tile_map) for _ in tabs],
+        out_specs=[pl.BlockSpec((TILE_HI, LANES), tile_map)
+                   for _ in tabs] + [
+            pl.BlockSpec((8, LANES), lambda b, *_: (0, 0))],
+        scratch_shapes=[pltpu.VMEM((TILE_HI, LANES), jnp.float32)],
+    )
+    out_shapes = [jax.ShapeDtypeStruct((num_buckets // LANES, LANES),
+                                       jnp.float32) for _ in tabs] + [
+        jax.ShapeDtypeStruct((8, LANES), jnp.float32)]
+    # alias each state table input onto its output: flat input index =
+    # 4 scalar-prefetch args + 2 (g, uniq) + table position
+    aliases = {4 + 2 + i: i for i in range(len(tabs))}
+    outs = pl.pallas_call(
+        partial(_kernel, algo=algo, dtype=dtype, fixed_bytes=fixed_bytes,
+                hyper=hyper),
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        input_output_aliases=aliases,
+        interpret=_use_interpret(),
+    )(tmap_u, first_u, last_u, qscale, g, uniq, *tabs)
+    new_tabs, nw = outs[:-1], outs[-1]
+    new_state = dict(state)
+    for k, t in zip(order, new_tabs):
+        new_state[k] = t.reshape(-1)
+    return new_state, nw[0, 0]
