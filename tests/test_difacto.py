@@ -188,7 +188,7 @@ def test_fm_compact_matches_xla_exactly(fm_file):
 
     def run(kernel):
         cfg = DifactoConfig(minibatch=256, num_buckets=2 * ck.TILE,
-                            v_buckets=4 * ck.TILE_HI, nnz_per_row=8,
+                            v_buckets=ck.TILE, nnz_per_row=8,
                             dim=4, threshold=0, lr_eta=0.3,
                             kernel=kernel, kernel_dtype="f32",
                             dropout=0.0)
@@ -214,7 +214,7 @@ def test_fm_compact_admission_and_convergence(fm_file):
     from wormhole_tpu.ops import coo_kernels as ck
 
     cfg = DifactoConfig(minibatch=256, num_buckets=2 * ck.TILE,
-                        v_buckets=4 * ck.TILE_HI, nnz_per_row=8,
+                        v_buckets=ck.TILE, nnz_per_row=8,
                         dim=4, threshold=3, lr_eta=0.3, V_lr_eta=0.1,
                         kernel="pallas", kernel_dtype="f32")
     lrn = DifactoLearner(cfg, make_mesh(1, 1))

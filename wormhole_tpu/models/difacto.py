@@ -177,7 +177,14 @@ class DifactoLearner:
             cfg.kernel == "pallas"
             or (cfg.kernel == "auto" and jax.default_backend() == "tpu")
         ) and (not cfg.l1_shrk and D == 1 and M_ == 1
-               and cfg.minibatch % 128 == 0)
+               and cfg.minibatch % 128 == 0
+               # the fused in-place V update needs rows that tile cleanly:
+               # dim a power of two dividing 128, V table a whole number
+               # of (TILE_HI, 128) flat tiles
+               and cfg.dim & (cfg.dim - 1) == 0 and 128 % cfg.dim == 0
+               and (cfg.vb * cfg.dim) % ck.TILE == 0
+               # the fused w update streams whole (TILE_HI, 128) tiles
+               and cfg.num_buckets % ck.TILE == 0)
         self._fm_caps = None
         self._fm_steps = None
         self._fm_lock = threading.Lock()
@@ -306,18 +313,26 @@ class DifactoLearner:
             return jnp.float32
         return None  # kernel default (bf16 on TPU, f32 in interpret)
 
+    @property
+    def _v_rows_per_tile(self) -> int:
+        return ck.TILE // self.cfg.dim
+
     def _pack_fm(self, db: DeviceBatch, train: bool):
         """Host pack (loader threads, serialized by _fm_lock so the count
-        mirror sees batches in order): localize w keys and V keys, apply
-        admission to the V values, and lay both out for the kernels."""
+        mirror sees batches in order): localize w keys and V row ids into
+        tile-run-aligned compact slots (coo_kernels.assign_tile_slots),
+        apply admission to the V values, and lay both out for the
+        kernels. The tile alignment is what lets the training step update
+        both tables in place (ops/fused_update.py) with no XLA element
+        gathers or scatters."""
         cfg = self.cfg
         idx64 = db.idx.astype(np.int64)
         live = db.val != 0
         loc = localize(idx64.astype(np.uint64))
         uniq = loc.uniq_keys.astype(np.int64)
-        slot = loc.local_index
+        inv = loc.local_index
         live_counts = np.bincount(
-            slot[live], minlength=len(uniq)).astype(np.float32)
+            inv[live], minlength=len(uniq)).astype(np.float32)
         with self._fm_lock:
             if self._fm_caps is None:
                 # the first batch to pack may be a short tail part: scale
@@ -326,28 +341,28 @@ class DifactoLearner:
                 # fragment
                 fill = cfg.row_capacity / max(int(live.sum()), 1)
                 scale = 1.5 * min(max(fill, 1.0), 4.0)
-                uw = -(-int(scale * len(uniq)) // ck.TILE) * ck.TILE
-                uv_est = (len(np.unique(idx64[live] % cfg.vb))
-                          if live.any() else 1)
-                uv = -(-int(scale * uv_est + 512)
-                       // ck.TILE_HI) * ck.TILE_HI
+                blocks_w = ck.tile_blocks_needed(uniq, ck.TILE)
+                uw = (-(-int(scale * blocks_w) * ck.BLK_U // ck.TILE)
+                      * ck.TILE)
+                vuniq0 = (np.unique(idx64[live] % cfg.vb)
+                          if live.any() else np.zeros(1, np.int64))
+                blocks_v = ck.tile_blocks_needed(vuniq0,
+                                                 self._v_rows_per_tile)
+                uv = int(scale * blocks_v + 1) * ck.BLK_U
                 self._fm_caps = (uw, uv)
                 self._build_fm(uw, uv)
         uw_cap, uv_cap = self._fm_caps
 
-        seg, val = db.seg, db.val
-        dropped = 0
-        if len(uniq) > uw_cap:
-            keep = slot < uw_cap
-            dropped += int(np.count_nonzero(~keep & live))
-            idx64, seg, val, slot = (idx64[keep], seg[keep], val[keep],
-                                     slot[keep])
-            live = val != 0
-            uniq, live_counts = uniq[:uw_cap], live_counts[:uw_cap]
-        out_uniq = np.full(uw_cap, cfg.num_buckets, np.int32)
-        out_uniq[: len(uniq)] = uniq
+        ts_w = ck.assign_tile_slots(uniq, ck.TILE, uw_cap, cfg.num_buckets)
+        slot_nz = ts_w.slot_of_uniq[inv]
+        keep = slot_nz < uw_cap
+        dropped = int(np.count_nonzero(~keep & live))
+        idx64, seg, val, slot_nz = (idx64[keep], db.seg[keep],
+                                    db.val[keep], slot_nz[keep])
+        live = val != 0
+        kept_r = ts_w.slot_of_uniq < uw_cap
         wcnts = np.zeros(uw_cap, np.float32)
-        wcnts[: len(live_counts)] = live_counts
+        wcnts[ts_w.slot_of_uniq[kept_r]] = live_counts[kept_r]
 
         # admission per key from the mirror; training includes this
         # batch's own counts (the reference makes the weight pull depend
@@ -357,33 +372,25 @@ class DifactoLearner:
         with self._fm_lock:
             cnt_key = self._cnt_host[uniq]
             if train:
-                cnt_key = cnt_key + live_counts[: len(uniq)]
-                self._cnt_host[uniq] += live_counts[: len(uniq)]
-        adm_key = cnt_key >= cfg.threshold
-        adm_nz = adm_key[slot] & live
+                cnt_key = cnt_key + live_counts
+                self._cnt_host[uniq[kept_r]] += live_counts[kept_r]
+        adm_nz = (cnt_key >= cfg.threshold)[inv][keep] & live
 
-        wcoo = ck.pack_sorted_coo(slot, seg, val, uw_cap,
+        wcoo = ck.pack_sorted_coo(slot_nz, seg, val, uw_cap,
                                   capacity=cfg.row_capacity)
 
-        # V domain: localize (bucket % vb) of admitted nonzeros
+        # V domain: localize (bucket % vb) row ids of the kept nonzeros
         vidx = (idx64 % cfg.vb).astype(np.uint64)
         loc_v = localize(vidx)
-        vuniq = loc_v.uniq_keys.astype(np.int64)
-        vslot = loc_v.local_index
+        ts_v = ck.assign_tile_slots(loc_v.uniq_keys, self._v_rows_per_tile,
+                                    uv_cap, cfg.vb)
+        vslot_nz = ts_v.slot_of_uniq[loc_v.local_index]
         vval = np.where(adm_nz, val, 0.0).astype(np.float32)
-        if len(vuniq) > uv_cap:
-            keepv = vslot < uv_cap
-            dropped += int(np.count_nonzero(~keepv & (vval != 0)))
-            segv, vvalv, vslotv = seg[keepv], vval[keepv], vslot[keepv]
-            vuniq = vuniq[:uv_cap]
-        else:
-            segv, vvalv, vslotv = seg, vval, vslot
-        out_vuniq = np.full(uv_cap, cfg.vb, np.int32)
-        out_vuniq[: len(vuniq)] = vuniq
+        keepv = vslot_nz < uv_cap
+        dropped += int(np.count_nonzero(~keepv & (vval != 0)))
+        segv, vvalv, vslotv = seg[keepv], vval[keepv], vslot_nz[keepv]
         vtouched = np.zeros(uv_cap, np.float32)
-        tv = np.bincount(vslotv[vvalv != 0],
-                         minlength=len(vuniq)).astype(np.float32)
-        vtouched[: len(tv)] = (tv > 0)
+        vtouched[np.unique(vslotv[vvalv != 0])] = 1.0
         vcoo = ck.pack_sorted_coo(vslotv, segv, vvalv, uv_cap,
                                   capacity=cfg.row_capacity,
                                   tile=ck.TILE_HI, blk=ck.FM_BLK)
@@ -394,11 +401,21 @@ class DifactoLearner:
                 "fm compaction overflow: dropped %d nonzeros — raise "
                 "the first batch's key diversity (caps %s)",
                 dropped, self._fm_caps)
-        return (out_uniq, wcnts, wcoo, out_vuniq, vtouched, vcoo)
+        return (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo)
 
     def _build_fm(self, uw_cap: int, uv_cap: int) -> None:
         cfg = self.cfg
         dt = self._fm_dtype_of()
+        from wormhole_tpu.ops.fused_update import (row_tile_gather,
+                                                   scatter_update,
+                                                   v_scatter_update)
+
+        def gather_compact(state, vstate, uniq_w, wtm, uniq_v, vtm):
+            wc = ck.tile_gather(state["w"].reshape(-1, ck.LANES),
+                                uniq_w, wtm, dtype=dt)
+            Vc = row_tile_gather(vstate["V"].reshape(-1, ck.LANES),
+                                 uniq_v, vtm, cfg.dim, dtype=dt)
+            return wc, Vc
 
         def forward(wc, Vc, pk_dev):
             (widx, wseg, wval, wtmap, wfirst,
@@ -413,27 +430,36 @@ class DifactoLearner:
             return xw, xv_img, margin
 
         @partial(jax.jit, donate_argnums=(0, 1))
-        def train_fm(state, vstate, uniq_w, wcnts, widx, wseg, wval,
-                     wtmap, wfirst, uniq_v, vtouched, vidx, vseg, vval,
-                     vtmap, vfirst, label, mask, rngkey):
-            zc = jnp.take(state["z"], uniq_w, mode="clip")
-            nc = jnp.take(state["n"], uniq_w, mode="clip")
-            eta = (cfg.lr_beta + jnp.sqrt(nc)) / cfg.lr_eta
-            wc = l1l2_solve(-zc, eta, cfg.lambda_l1, cfg.lambda_l2)
-            Vc = jnp.take(vstate["V"], uniq_v, axis=0, mode="clip")
-            nVc = jnp.take(vstate["nV"], uniq_v, axis=0, mode="clip")
+        def train_fm(state, vstate, uniq_w, wtm, wfi, wla, wcnts,
+                     widx, wseg, wval, wtmap, wfirst,
+                     uniq_v, vtm, vfi, vla, vtouched,
+                     vidx, vseg, vval, vtmap, vfirst, label, mask, rngkey):
+            wc, Vc = gather_compact(state, vstate, uniq_w, wtm,
+                                    uniq_v, vtm)
             pk_dev = (widx, wseg, wval, wtmap, wfirst,
                       vidx, vseg, vval, vtmap, vfirst)
             xw, xv_img, margin = forward(wc, Vc, pk_dev)
             obj, d = linmod._loss_dual(cfg.loss, label, margin)
             d = d * mask
 
+            # w: FTRL at the key's storage — scatter + handle update run
+            # inside the fused kernel over touched tiles, in place
             gw = ck.coo_spmv_t(d, widx, wseg, wval, wtmap, wfirst,
                                uw_cap, dtype=dt)
-            gw = quantize_push(gw, cfg.fixed_bytes)
-            lin_new = linmod._update(
-                "ftrl", {"w": wc, "z": zc, "n": nc}, gw, 1.0, cfg)
+            new_state, new_w = scatter_update(
+                "ftrl", state, gw, uniq_w, wtm, wfi, wla,
+                lr_eta=cfg.lr_eta, lr_beta=cfg.lr_beta,
+                lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+                fixed_bytes=cfg.fixed_bytes, dtype=dt)
+            # counts are additive: one sorted-unique scatter-add
+            # no unique_indices hint: the sentinel index repeats in every
+            # alignment hole, and lying to the scatter about uniqueness is
+            # undefined behavior
+            new_state["cnt"] = state["cnt"].at[uniq_w].add(
+                wcnts, mode="drop")
 
+            # V: AdaGrad at the row's storage, same treatment; the grad
+            # filters apply on the compact gradient beforehand
             gV = ck.fm_push(Vc, d, xv_img, vidx, vseg, vval, vtmap,
                             vfirst, dtype=dt)
             if cfg.grad_normalization:
@@ -445,40 +471,25 @@ class DifactoLearner:
                                             gV.shape)
                 gV = gV * keep
             gV = quantize_push(gV, cfg.fixed_bytes)
-            tv = vtouched[:, None]
-            nV_new = nVc + tv * gV * gV
-            etaV = (cfg.V_lr_beta + jnp.sqrt(nV_new)) / cfg.V_lr_eta
-            V_new = jnp.where(tv > 0,
-                              Vc - (gV + cfg.lambda_V * Vc) / etaV, Vc)
-
-            new_state = dict(state)
-            new_state["z"] = state["z"].at[uniq_w].set(
-                lin_new["z"], mode="drop")
-            new_state["n"] = state["n"].at[uniq_w].set(
-                lin_new["n"], mode="drop")
-            new_state["w"] = state["w"].at[uniq_w].set(
-                lin_new["w"], mode="drop")
-            # counts are additive: scatter-add avoids gathering cnt at all
-            new_state["cnt"] = state["cnt"].at[uniq_w].add(
-                wcnts, mode="drop")
+            Vn, nVn = v_scatter_update(
+                vstate["V"], vstate["nV"], gV, vtouched, uniq_v,
+                vtm, vfi, vla, dim=cfg.dim, V_lr_eta=cfg.V_lr_eta,
+                V_lr_beta=cfg.V_lr_beta, lambda_V=cfg.lambda_V, dtype=dt)
             new_vstate = dict(vstate)
-            new_vstate["V"] = vstate["V"].at[uniq_v].set(
-                V_new, mode="drop")
-            new_vstate["nV"] = vstate["nV"].at[uniq_v].set(
-                nV_new, mode="drop")
-            new_w = (jnp.sum(lin_new["w"] != 0)
-                     - jnp.sum(wc != 0)).astype(jnp.float32)
+            new_vstate["V"] = Vn
+            new_vstate["nV"] = nVn
+
             prog = linmod._progress(obj, margin, label, mask, new_w)
             obj_w, _ = linmod._loss_dual(cfg.loss, label, xw)
             prog["objv_w"] = jnp.sum(obj_w * mask)
             return new_state, new_vstate, prog
 
         @jax.jit
-        def fwd_fm(state, vstate, uniq_w, widx, wseg, wval, wtmap,
-                   wfirst, uniq_v, vidx, vseg, vval, vtmap, vfirst,
+        def fwd_fm(state, vstate, uniq_w, wtm, widx, wseg, wval, wtmap,
+                   wfirst, uniq_v, vtm, vidx, vseg, vval, vtmap, vfirst,
                    label, mask):
-            wc = jnp.take(state["w"], uniq_w, mode="clip")
-            Vc = jnp.take(vstate["V"], uniq_v, axis=0, mode="clip")
+            wc, Vc = gather_compact(state, vstate, uniq_w, wtm,
+                                    uniq_v, vtm)
             pk_dev = (widx, wseg, wval, wtmap, wfirst,
                       vidx, vseg, vval, vtmap, vfirst)
             _, _, margin = forward(wc, Vc, pk_dev)
@@ -502,17 +513,20 @@ class DifactoLearner:
         return ("fm", args, blk.size, train)
 
     def _fm_args(self, pk, label, mask, train: bool):
-        uniq_w, wcnts, wcoo, uniq_v, vtouched, vcoo = pk
+        ts_w, wcnts, wcoo, ts_v, vtouched, vcoo = pk
         j = jnp.asarray
         wparts = [j(wcoo.idx), j(wcoo.seg), j(wcoo.val), j(wcoo.tmap),
                   j(wcoo.first)]
         vparts = [j(vcoo.idx), j(vcoo.seg), j(vcoo.val), j(vcoo.tmap),
                   j(vcoo.first)]
         if train:
-            return ([j(uniq_w), j(wcnts)] + wparts
-                    + [j(uniq_v), j(vtouched)] + vparts
+            return ([j(ts_w.uniq), j(ts_w.tmap_u), j(ts_w.first_u),
+                     j(ts_w.last_u), j(wcnts)] + wparts
+                    + [j(ts_v.uniq), j(ts_v.tmap_u), j(ts_v.first_u),
+                       j(ts_v.last_u), j(vtouched)] + vparts
                     + [j(label), j(mask)])
-        return ([j(uniq_w)] + wparts + [j(uniq_v)] + vparts
+        return ([j(ts_w.uniq), j(ts_w.tmap_u)] + wparts
+                + [j(ts_v.uniq), j(ts_v.tmap_u)] + vparts
                 + [j(label), j(mask)])
 
     # -- global-mesh SPMD protocol (apps/_runner._global_train) ------------

@@ -412,8 +412,7 @@ class LinearLearner:
         if cfg.compact_cap > 0:
             return -(-cfg.compact_cap // ck.TILE) * ck.TILE
         ids = np.unique(np.asarray(idx, np.int64))
-        n_t = np.bincount(ids // ck.TILE)
-        blocks = int(np.sum(-(-n_t[n_t > 0] // ck.BLK_U)))
+        blocks = ck.tile_blocks_needed(ids, ck.TILE)
         cand = -(-int(1.5 * blocks) * ck.BLK_U // ck.TILE) * ck.TILE
         if cfg.num_buckets >= 32 * cand:
             return cand

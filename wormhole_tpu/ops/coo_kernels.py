@@ -54,9 +54,12 @@ TILE_HI = int(os.environ.get("WORMHOLE_TILE_HI", 512))  # sublanes per tile
 LANES = 128
 TILE = TILE_HI * LANES  # buckets per table tile
 BLK = int(os.environ.get("WORMHOLE_BLK", 4096))  # nnz per grid block
-# The FM kernels keep dim-many per-nnz temporaries alive per block, so
-# they run at a smaller block size to stay inside scoped VMEM.
+# The FM kernels keep dim-many per-nnz temporaries alive per block.
+# Swept on v5e: 1024 beats 2048/4096 (their per-block operands blow the
+# VMEM working set and stall the pipeline; the kernels are VPU-
+# throughput-bound, ~1 ns/nnz/channel, not per-block-overhead-bound).
 FM_BLK = int(os.environ.get("WORMHOLE_FM_BLK", 1024))
+_FM_VMEM_LIMIT = int(os.environ.get("WORMHOLE_FM_VMEM", 64 * 2**20))
 
 
 def _use_interpret() -> bool:
@@ -374,24 +377,43 @@ class TileCOO:
     dropped_nnz: int    # their nonzeros, dropped with them
 
 
-def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
-                  capacity: int | None = None) -> TileCOO:
-    """Localize bucket ids (the reference Localizer's sort+unique+remap,
-    localizer.h:98-221) into tile-run-aligned compact slots and pack the
-    COO triples over that domain (host-side, loader threads)."""
-    assert u_cap % TILE == 0, f"u_cap must be a multiple of {TILE}"
-    assert num_buckets < 2**31, "sentinel id must fit int32"
-    from wormhole_tpu.ops.localizer import localize
+@dataclasses.dataclass
+class TileSlots:
+    """Tile-run-aligned compact slot assignment for a set of unique ids
+    (scalar bucket ids, or embedding ROW ids when rows_per_tile < TILE)."""
 
-    idx = np.asarray(idx, np.int64)
-    seg = np.asarray(seg, np.int32)
-    val = np.asarray(val, np.float32)
-    loc = localize(idx.astype(np.uint64))
-    uniq = loc.uniq_keys.astype(np.int64)          # sorted
-    inv = loc.local_index                          # nnz -> rank in uniq
+    uniq: np.ndarray      # (u_cap,) int32 id per slot; sentinel in holes
+    tmap_u: np.ndarray    # (u_cap/BLK_U,) int32 table tile per block
+    first_u: np.ndarray   # (u_cap/BLK_U,)
+    last_u: np.ndarray    # (u_cap/BLK_U,)
+    slot_of_uniq: np.ndarray  # (n_uniq,) int64 slot per unique (u_cap = cut)
+    num_uniq: int
+    dropped_uniq: int
+
+
+def tile_blocks_needed(ids, rows_per_tile: int) -> int:
+    """How many BLK_U update blocks assign_tile_slots will allocate for
+    these unique ids: the ceil-div per touched tile. Capacity sizers must
+    use this (not a hand-copied formula) so they can never drift from the
+    packing policy."""
+    n_t = np.bincount(np.asarray(ids, np.int64) // rows_per_tile)
+    n_t = n_t[n_t > 0]
+    if len(n_t) == 0:
+        return 1
+    return int(np.sum(-(-n_t // BLK_U)))
+
+
+def assign_tile_slots(uniq, rows_per_tile: int, u_cap: int,
+                      sentinel: int) -> TileSlots:
+    """Group sorted unique ids by home table tile (rows_per_tile ids per
+    tile) and give each tile's run a BLK_U-aligned contiguous slot range.
+    On overflow, whole tiles (plus a truncated boundary tile) are kept in
+    id order and the rest cut."""
+    assert u_cap % BLK_U == 0
+    uniq = np.asarray(uniq, np.int64)
     nb = u_cap // BLK_U
 
-    tile_of = (uniq // TILE).astype(np.int64)
+    tile_of = uniq // rows_per_tile
     t_ids, n_t = np.unique(tile_of, return_counts=True)
     b_t = np.maximum((n_t + BLK_U - 1) // BLK_U, 1)
     # cap: keep whole tiles (and a truncated final tile) within nb blocks
@@ -422,7 +444,7 @@ def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
     slot_of_uniq[:kept_uniq] = (dst_base[tile_rank]
                                 + rank[:kept_uniq] - src_base[tile_rank])
 
-    out_uniq = np.full(u_cap, num_buckets, np.int32)
+    out_uniq = np.full(u_cap, sentinel, np.int32)
     out_uniq[slot_of_uniq[:kept_uniq]] = uniq[:kept_uniq]
 
     tmap_u = np.zeros(nb, np.int32)
@@ -438,14 +460,32 @@ def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
     else:  # degenerate empty batch: one harmless copy-through of tile 0
         first_u[0] = 1
         last_u[0] = 1
+    return TileSlots(out_uniq, tmap_u, first_u, last_u, slot_of_uniq,
+                     kept_uniq, dropped_uniq)
 
-    new_slot = slot_of_uniq[inv]
+
+def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
+                  capacity: int | None = None) -> TileCOO:
+    """Localize bucket ids (the reference Localizer's sort+unique+remap,
+    localizer.h:98-221) into tile-run-aligned compact slots and pack the
+    COO triples over that domain (host-side, loader threads)."""
+    assert u_cap % TILE == 0, f"u_cap must be a multiple of {TILE}"
+    assert num_buckets < 2**31, "sentinel id must fit int32"
+    from wormhole_tpu.ops.localizer import localize
+
+    idx = np.asarray(idx, np.int64)
+    seg = np.asarray(seg, np.int32)
+    val = np.asarray(val, np.float32)
+    loc = localize(idx.astype(np.uint64))
+    ts = assign_tile_slots(loc.uniq_keys, TILE, u_cap, num_buckets)
+
+    new_slot = ts.slot_of_uniq[loc.local_index]
     keep = new_slot < u_cap
     dropped_nnz = int(np.count_nonzero(~keep))
     p = pack_sorted_coo(new_slot[keep], seg[keep], val[keep], u_cap,
                         capacity=capacity)
-    return TileCOO(out_uniq, p, tmap_u, first_u, last_u, kept_uniq,
-                   dropped_uniq, dropped_nnz)
+    return TileCOO(ts.uniq, p, ts.tmap_u, ts.first_u, ts.last_u,
+                   ts.num_uniq, ts.dropped_uniq, dropped_nnz)
 
 
 def _tile_gather_kernel(tmap_ref, w_ref, uniq_ref, out_ref, *, dtype):
@@ -497,17 +537,23 @@ def tile_gather(table2, uniq, tmap_u, dtype=None):
 # shape on v5e.
 
 
+# channel-group width for the wide-N scatter matmuls: enough lanes to
+# keep the MXU busy, small enough that the (BLK, group) operand and the
+# (R, group) accumulator stay inside scoped VMEM at any dim
+_FM_GROUP = 16  # k-channels per matmul group (16 * 128 = 2048 lanes)
+
+
 def _fm_pull_kernel(tmap_ref, first_ref, V_ref, idx_ref, seg_ref, val_ref,
-                    *out_refs, num_rows: int, dim: int, dtype):
-    # out_refs = dim x xv_k then dim x x2_k, each a (R, LANES) radix image
-    # (2-D refs: Mosaic handles their read-modify-write; a 3-D [dim, R,
-    # LANES] ref does not lower)
+                    out_ref, *, num_rows: int, dim: int, dtype):
+    # out_ref: (R, 2*dim*LANES) — xv_k images in lane groups [k*128,
+    # (k+1)*128), then x2_k images. One wide-N matmul per channel group
+    # replaces the former per-k (R, BLK) @ (BLK, 128) loop, whose skinny
+    # N=128 matmuls left the MXU mostly idle.
     blk = pl.program_id(0)
 
     @pl.when(blk == 0)
     def _():
-        for r in out_refs:
-            r[:] = jnp.zeros_like(r)
+        out_ref[:] = jnp.zeros_like(out_ref)
 
     local = idx_ref[:] - tmap_ref[blk] * TILE_HI
     e = _onehot(local, TILE_HI, dtype)
@@ -523,23 +569,25 @@ def _fm_pull_kernel(tmap_ref, first_ref, V_ref, idx_ref, seg_ref, val_ref,
     rlo = seg_ref[:] & (LANES - 1)
     e_rt = _onehot_t(rhi, num_rows // LANES, dtype)
     c_r = _onehot(rlo, LANES, dtype)
-    for k in range(dim):
+
+    def chan(k):
         # static slices: Mosaic's gather rule rejects integer indexing
         # on the minor (dim) axis
-        p_k = jax.lax.slice_in_dim(p, k, k + 1, axis=1)
-        p2_k = jax.lax.slice_in_dim(p2, k, k + 1, axis=1)
-        out_refs[k][:] += jax.lax.dot_general(
-            e_rt, (p_k * c_r).astype(dtype),
+        src, kk = (p, k) if k < dim else (p2, k - dim)
+        return jax.lax.slice_in_dim(src, kk, kk + 1, axis=1) * c_r
+
+    for g0 in range(0, 2 * dim, _FM_GROUP):
+        g1 = min(g0 + _FM_GROUP, 2 * dim)
+        # built lazily per group so at most _FM_GROUP (BLK, 128) channel
+        # operands are live at once
+        rhs = jnp.concatenate([chan(k) for k in range(g0, g1)], axis=1)
+        got = jax.lax.dot_general(
+            e_rt, rhs.astype(dtype),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=_prec(dtype),
         )
-        out_refs[dim + k][:] += jax.lax.dot_general(
-            e_rt, (p2_k * c_r).astype(dtype),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=_prec(dtype),
-        )
+        out_ref[:, g0 * LANES:g1 * LANES] += got
 
 
 def fm_pull(V, sidx, sseg, sval, tmap, first, num_rows: int, dtype=None):
@@ -564,17 +612,18 @@ def fm_pull(V, sidx, sseg, sval, tmap, first, num_rows: int, dtype=None):
             pl.BlockSpec((blk,), lambda b, *_: (b,)),
             pl.BlockSpec((blk,), lambda b, *_: (b,)),
         ],
-        out_specs=[pl.BlockSpec((R, LANES), lambda b, *_: (0, 0))
-                   for _ in range(2 * dim)],
+        out_specs=pl.BlockSpec((R, 2 * dim * LANES), lambda b, *_: (0, 0)),
     )
-    outs = pl.pallas_call(
+    out = pl.pallas_call(
         partial(_fm_pull_kernel, num_rows=num_rows, dim=dim, dtype=dtype),
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((R, LANES), jnp.float32)
-                   for _ in range(2 * dim)],
+        out_shape=jax.ShapeDtypeStruct((R, 2 * dim * LANES), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_FM_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap, first, V, sidx, sseg, sval)
-    return jnp.stack(outs[:dim]), jnp.stack(outs[dim:])
+    img = out.reshape(R, 2 * dim, LANES).transpose(1, 0, 2)
+    return img[:dim], img[dim:]
 
 
 def fm_rows(x) -> jax.Array:
@@ -583,11 +632,11 @@ def fm_rows(x) -> jax.Array:
     return x.transpose(1, 2, 0).reshape(R * L, dim)
 
 
-def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, *rest,
+def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, xv_ref,
+                    idx_ref, seg_ref, val_ref, out_ref, *,
                     dim: int, dtype):
-    # rest = dim x xv_k (R, LANES) inputs, then idx, seg, val, out_ref
-    xv_refs = rest[:dim]
-    idx_ref, seg_ref, val_ref, out_ref = rest[dim:]
+    # xv_ref: (R, dim*LANES) — fm_pull's xv images concatenated along
+    # lanes, so one wide-N matmul per chunk fetches all dim channels
     blk = pl.program_id(0)
 
     @pl.when(first_ref[blk] == 1)
@@ -607,24 +656,25 @@ def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, *rest,
     c_rlo = _onehot(rlo, LANES, dtype)
     d_j = _lane_pick(_row_fetch(d_ref[:], rhi, dtype), c_rlo)
     # fetch xv[seg] for all dim channels, chunked along the nnz axis so
-    # the (chunk, 128) fetch temporaries stay within scoped VMEM
+    # the (chunk, dim*128) fetch temporaries stay within scoped VMEM
     nnz_blk = rhi.shape[0]
-    ch = min(1024, nnz_blk)
+    ch = max(LANES, min(1024, 8192 // dim))
+    ch = min(ch, nnz_blk)
     y_chunks = []
     for c0 in range(0, nnz_blk, ch):
         hi_end = min(c0 + ch, nnz_blk)
         rhi_c = jax.lax.slice_in_dim(rhi, c0, hi_end)
         c_rlo_c = jax.lax.slice_in_dim(c_rlo, c0, hi_end, axis=0)
         e_rc = _onehot(rhi_c, d_ref.shape[0], dtype)
-        ys = []
-        for k in range(dim):
-            t_k = jax.lax.dot_general(
-                e_rc, xv_refs[k][:].astype(dtype),
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=_prec(dtype),
-            )                                     # [ch, 128]
-            ys.append(_lane_pick(t_k, c_rlo_c))
+        t = jax.lax.dot_general(
+            e_rc, xv_ref[:].astype(dtype),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(dtype),
+        )                                         # [ch, dim*128]
+        ys = [_lane_pick(
+            jax.lax.slice_in_dim(t, k * LANES, (k + 1) * LANES, axis=1),
+            c_rlo_c) for k in range(dim)]
         y_chunks.append(jnp.stack(ys, axis=1))
     y = jnp.concatenate(y_chunks, axis=0)         # xv[seg]  [BLK, dim]
     c = d_j * val_ref[:]
@@ -659,8 +709,7 @@ def fm_push(V, d, xv, sidx, sseg, sval, tmap, first, dtype=None):
         in_specs=[
             pl.BlockSpec((TILE_HI, dim), lambda b, tmap, first: (tmap[b], 0)),
             pl.BlockSpec((R, LANES), lambda b, *_: (0, 0)),
-        ] + [pl.BlockSpec((R, LANES), lambda b, *_: (0, 0))
-             for _ in range(dim)] + [
+            pl.BlockSpec((R, dim * LANES), lambda b, *_: (0, 0)),
             pl.BlockSpec((blk,), lambda b, *_: (b,)),
             pl.BlockSpec((blk,), lambda b, *_: (b,)),
             pl.BlockSpec((blk,), lambda b, *_: (b,)),
@@ -668,13 +717,17 @@ def fm_push(V, d, xv, sidx, sseg, sval, tmap, first, dtype=None):
         out_specs=pl.BlockSpec((TILE_HI, dim),
                                lambda b, tmap, first: (tmap[b], 0)),
     )
-    xv_parts = [xv[k] for k in range(dim)]
+    # xv arrives as the [dim, R, 128] stacked images; the kernel wants
+    # them lane-concatenated per row group
+    xv_wide = xv.transpose(1, 0, 2).reshape(R, dim * LANES)
     return pl.pallas_call(
         partial(_fm_push_kernel, dim=dim, dtype=dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, dim), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_FM_VMEM_LIMIT),
         interpret=_use_interpret(),
-    )(tmap, first, V, d2, *xv_parts, sidx, sseg, sval)
+    )(tmap, first, V, d2, xv_wide, sidx, sseg, sval)
 
 
 # ---------------------------------------------------------- mesh sharding
