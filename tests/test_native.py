@@ -224,3 +224,36 @@ def test_localize_native_path_matches_unique():
     np.testing.assert_array_equal(loc.uniq_keys, uniq)
     np.testing.assert_array_equal(loc.local_index, inv.astype(np.int32))
     np.testing.assert_array_equal(loc.counts, counts.astype(np.int32))
+
+
+def test_native_concurrent_stress():
+    """Hammer the native entry points from many threads at once — the
+    workload the loader threads create in production. Run under the
+    Makefile's asan/tsan builds (WORMHOLE_NATIVE_LIB) in CI; the
+    reference has no sanitizer coverage anywhere (SURVEY §5), this is
+    the improvement it calls for."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from wormhole_tpu import native
+
+    if native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(11)
+    lines = "\n".join(
+        "1 " + " ".join(f"{f}:2" for f in rng.integers(0, 1 << 18, 20))
+        for _ in range(2000)) + "\n"
+    keys = rng.integers(0, 1 << 30, size=200000).astype(np.uint64)
+    vals = rng.standard_normal(200000).astype(np.float32)
+
+    def work(i):
+        blk = native.parse_text(lines, "libsvm")
+        order = native.radix_argsort(keys)
+        got = native.gather(vals, order)
+        h = native.cityhash64(b"stress-%d" % i)
+        return blk.size, int(order[0]), float(got[0]), h
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        results = list(ex.map(work, range(32)))
+    sizes = {r[0] for r in results}
+    firsts = {r[1] for r in results}
+    assert sizes == {2000} and len(firsts) == 1

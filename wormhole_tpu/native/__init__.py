@@ -8,7 +8,11 @@ wormhole_tpu/data/parsers.py stay the reference implementation and the
 fallback — `tests/test_native.py` cross-checks the two bit-for-bit.
 
 The library is built lazily on first use (`make -C wormhole_tpu/native`);
-set WORMHOLE_NO_NATIVE=1 to force the pure-Python path.
+set WORMHOLE_NO_NATIVE=1 to force the pure-Python path, or
+WORMHOLE_NATIVE_LIB=/path/to/lib.so to load a specific build — that is
+how the sanitizer CI job runs the suite against the asan/tsan/ubsan
+targets of the Makefile (the race/memory checking the reference never
+had, SURVEY §5).
 """
 
 from __future__ import annotations
@@ -97,6 +101,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        override = os.environ.get("WORMHOLE_NATIVE_LIB")
+        if override:
+            # an explicit override must fail LOUDLY: silently returning
+            # None would make every native test skip and a sanitizer CI
+            # job pass while testing nothing
+            try:
+                _lib = _bind(ctypes.CDLL(override))
+            except (OSError, AttributeError) as e:
+                raise RuntimeError(
+                    f"WORMHOLE_NATIVE_LIB={override!r} failed to load or "
+                    f"is missing symbols: {e}") from e
+            return _lib
         if _stale() and not _build():
             return None
         try:
