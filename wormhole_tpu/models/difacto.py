@@ -611,6 +611,26 @@ class DifactoLearner:
             admit &= np.asarray(self.store.state["w"]) != 0
         return int(admit.sum())
 
+    def v_collision_rate(self) -> float:
+        """Fraction of ADMITTED keys whose V bucket (key % v_buckets) is
+        shared with another admitted key. The reference stores exact
+        per-key embeddings (async_sgd.h:135-209); the fixed-capacity V
+        table is a hash kernel, and this is the metric that bounds the
+        aliasing it introduces — size v_buckets so this stays small
+        (rate ~ n_admitted / v_buckets for a uniform hash; see
+        docs/difacto.md)."""
+        cnt = np.asarray(self.store.state["cnt"])
+        admit = cnt >= self.cfg.threshold
+        if self.cfg.l1_shrk:
+            admit &= np.asarray(self.store.state["w"]) != 0
+        keys = np.flatnonzero(admit)
+        if len(keys) == 0:
+            return 0.0
+        vb_of = keys % self.cfg.vb
+        _, counts = np.unique(vb_of, return_counts=True)
+        collided = int(np.sum(counts[counts > 1]))
+        return collided / len(keys)
+
 
 def make_early_stop_hook(cfg: DifactoConfig):
     """Early stop when validation objective stops improving by epsilon
