@@ -74,6 +74,13 @@ class LinearConfig:
     # criteo.conf:21): in the multi-process launch, the max number of
     # minibatches a worker trains between syncs against the server group
     max_delay: int = 16
+    # concurrent in-flight minibatches per worker (reference
+    # minibatch_solver.h:215-242 max_concurrency): here the number of
+    # loader threads preparing batches (parse + pack) while the device
+    # steps — the synchronous-XLA analog of overlapping pull/compute/push
+    # of successive minibatches. 4 keeps a ~17 ms device step fed when a
+    # 64k-row pack costs ~100 ms of host work.
+    max_concurrency: int = 4
     # multi-process dispatch: online (greedy, straggler-reassigning) or
     # batch (stable n/num_workers assignment per pass); local_data asks
     # each worker to match train_data against ITS filesystem and report,

@@ -39,8 +39,12 @@ class MinibatchSolver:
     the watchdog is for the multi-host scheduler (launcher/dmlc_tpu.py)
     where a straggling host's parts move to another host."""
 
-    def __init__(self, learner, cfg, num_loaders: int = 2,
+    def __init__(self, learner, cfg, num_loaders: int | None = None,
                  max_queued: int = 8, verbose: bool = True):
+        if num_loaders is None:
+            # the reference's max_concurrency knob (minibatch_solver.h:
+            # 215-242): concurrently-prepared in-flight minibatches
+            num_loaders = getattr(cfg, "max_concurrency", 2)
         self.learner = learner
         self.cfg = cfg
         self.num_loaders = num_loaders
