@@ -276,19 +276,26 @@ def bench_gbdt(rounds=8):
         mask=jax.device_put(np.ones(n, np.float32), b1),
         num_real=n,
     )
-    gh, upd = lrn._round_fns()
+    round_fn = lrn._fused_round_fn()
     margin = lrn._base_margins(ds)
 
     def do_rounds(r):
         nonlocal margin
         for _ in range(r):
-            g, h = gh(margin, ds.label, ds.mask)
-            tree, node = lrn._build_tree(ds, g, h)  # host-syncs per level
-            margin = upd(margin, tree["leaf_value"], node)
+            # one dispatch per round: grad/hess + all levels + update
+            tree, node, margin = round_fn(ds.binned, ds.label, ds.mask,
+                                          margin)
+
+    import jax.numpy as jnp
+
+    def force():
+        float(jnp.sum(margin))  # block_until_ready lies through the relay
 
     do_rounds(2)  # warmup/compile
+    force()
     t0 = time.perf_counter()
     do_rounds(rounds)
+    force()
     sec = (time.perf_counter() - t0) / rounds
     return 1.0 / sec, n / sec
 

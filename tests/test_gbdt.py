@@ -270,3 +270,61 @@ def test_reservoir_sample_caps_and_discovers_dim(tmp_path):
     sample2, n2, _ = _reservoir_sample(str(p), "libsvm", 1, 128, seed=0,
                                        cap=1000)
     assert n2 == 500 and len(sample2) == 500
+
+
+def test_mxu_hist_matches_scatter():
+    """ops/hist.level_hist (the MXU one-hot-matmul histogram) must agree
+    exactly with the segment-sum scatter formulation on every (node,
+    feature, bin) cell, including inactive rows (rel == num_nodes)."""
+    import jax.numpy as jnp
+
+    from wormhole_tpu.ops.hist import level_hist
+
+    rng = np.random.default_rng(4)
+    rows, F, B, nodes = 600, 5, 16, 4
+    binned = rng.integers(0, B, (rows, F)).astype(np.uint8)
+    g = rng.standard_normal(rows).astype(np.float32)
+    h = rng.random(rows).astype(np.float32)
+    rel = rng.integers(0, nodes + 1, rows).astype(np.int32)  # some inactive
+    G, H = level_hist(jnp.asarray(binned), jnp.asarray(g), jnp.asarray(h),
+                      jnp.asarray(rel), nodes, B)
+    # reference: plain numpy accumulation
+    Gr = np.zeros((nodes, F, B), np.float32)
+    Hr = np.zeros((nodes, F, B), np.float32)
+    for i in range(rows):
+        if rel[i] < nodes:
+            for f in range(F):
+                Gr[rel[i], f, binned[i, f]] += g[i]
+                Hr[rel[i], f, binned[i, f]] += h[i]
+    # the kernel's bf16 hi/lo gradient split carries ~2^-16 relative
+    # residual per element; sums stay well inside 1e-4
+    np.testing.assert_allclose(np.asarray(G), Gr, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(H), Hr, rtol=1e-4, atol=1e-4)
+
+
+def test_tree_lookup_exact_above_bf16_integer_range():
+    """split_feat ids above 256 (any dataset with >257 features) must
+    survive the one-hot-matmul lookup exactly — they ride as hi/lo
+    bytes because bf16 only represents integers exactly up to 256."""
+    import jax.numpy as jnp
+
+    from wormhole_tpu.models.gbdt import _tree_lookup
+
+    T = 15
+    sf = np.array([0, 255, 256, 257, 300, 511, 513, 783, 1000, 40000,
+                   1, 2, 3, 4, 5], np.int32)
+    trees = {
+        "split_feat": jnp.asarray(sf),
+        "split_bin": jnp.asarray(np.arange(T, dtype=np.int32) * 17 % 256),
+        "is_split": jnp.asarray((np.arange(T) % 2).astype(bool)),
+        "leaf_value": jnp.asarray(np.linspace(-2, 2, T, dtype=np.float32)),
+    }
+    node = jnp.asarray(np.arange(T, dtype=np.int32))
+    nf, thr, isp, leaf = _tree_lookup(node, trees, T)
+    np.testing.assert_array_equal(np.asarray(nf), sf)
+    np.testing.assert_array_equal(np.asarray(thr),
+                                  np.asarray(trees["split_bin"]))
+    np.testing.assert_array_equal(np.asarray(isp),
+                                  np.asarray(trees["is_split"]))
+    np.testing.assert_allclose(np.asarray(leaf),
+                               np.asarray(trees["leaf_value"]), rtol=1e-5)

@@ -81,6 +81,11 @@ class GbdtConfig:
     minibatch: int = 65536  # streaming-load chunk size
     num_parts_per_file: int = 1
     seed: int = 0
+    # histogram backend: mxu (Pallas one-hot-matmul kernel,
+    # ops/hist.py — ~40x faster than the scatter on TPU) | xla
+    # (segment-sum scatter) | auto (mxu on TPU, xla elsewhere — the
+    # interpreted kernel is too slow for CPU test loops)
+    hist_kernel: str = "auto"
 
 
 # ---------------------------------------------------------------------------
@@ -300,7 +305,7 @@ class GbdtLearner:
         compilations."""
         c = self.cfg
         return (c.dim, c.max_bin, c.max_depth, c.reg_lambda, c.gamma,
-                c.min_child_weight, c.eta, c.objective)
+                c.min_child_weight, c.eta, c.objective, c.hist_kernel)
 
     def _level_fn(self, num_nodes: int, offset: int, last: bool):
         key = (num_nodes, offset, last, self._hyper_key())
@@ -313,28 +318,44 @@ class GbdtLearner:
                               cfg.min_child_weight, cfg.eta)
         mesh = self.mesh
 
+        use_mxu_hist = cfg.hist_kernel == "mxu" or (
+            cfg.hist_kernel == "auto" and jax.default_backend() == "tpu")
+
         def local_hist(binned, g, h, rel):
             """Per-shard (node, feature, bin) histograms + psum — the
             rabit::Allreduce of gradient histograms."""
-            n = g.shape[0]
-            base = rel[:, None] * (F * B) + jnp.arange(F, dtype=jnp.int32)[None, :] * B
-            idx = base + binned.astype(jnp.int32)          # [n, F]
-            # inactive rows got rel == num_nodes -> index >= num_segments,
-            # dropped by the scatter (OOB updates are discarded)
-            gb = jnp.broadcast_to(g[:, None], (n, F)).ravel()
-            hb = jnp.broadcast_to(h[:, None], (n, F)).ravel()
-            flat = idx.ravel()
-            G = jax.ops.segment_sum(gb, flat, num_segments=num_nodes * F * B)
-            H = jax.ops.segment_sum(hb, flat, num_segments=num_nodes * F * B)
+            if use_mxu_hist:
+                # MXU one-hot-matmul histogram (ops/hist.py): the XLA
+                # scatter costs ~10ns per rows x F element on TPU
+                from wormhole_tpu.ops.hist import level_hist
+
+                G, H = level_hist(binned, g, h, rel, num_nodes, B)
+            else:
+                n = g.shape[0]
+                base = (rel[:, None] * (F * B)
+                        + jnp.arange(F, dtype=jnp.int32)[None, :] * B)
+                idx = base + binned.astype(jnp.int32)      # [n, F]
+                # inactive rows got rel == num_nodes -> index >=
+                # num_segments, dropped by the scatter
+                gb = jnp.broadcast_to(g[:, None], (n, F)).ravel()
+                hb = jnp.broadcast_to(h[:, None], (n, F)).ravel()
+                flat = idx.ravel()
+                G = jax.ops.segment_sum(
+                    gb, flat, num_segments=num_nodes * F * B)
+                H = jax.ops.segment_sum(
+                    hb, flat, num_segments=num_nodes * F * B)
+                G = G.reshape(num_nodes, F, B)
+                H = H.reshape(num_nodes, F, B)
             G = jax.lax.psum(G, DATA_AXIS)
             H = jax.lax.psum(H, DATA_AXIS)
-            return (G.reshape(num_nodes, F, B), H.reshape(num_nodes, F, B))
+            return G, H
 
         hist = jax.shard_map(
             local_hist, mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS)),
             out_specs=(P(), P()),
+            check_vma=False,  # pallas_call out_shape carries no vma
         )
 
         @jax.jit
@@ -369,12 +390,12 @@ class GbdtLearner:
             trees["is_split"] = trees["is_split"].at[sl].set(do_split)
             trees["leaf_value"] = trees["leaf_value"].at[sl].set(
                 jnp.where(do_split, 0.0, leaf))
-            # route rows into children
-            nf = trees["split_feat"][node]
-            thr = trees["split_bin"][node]
-            bv = jnp.take_along_axis(
-                binned.astype(jnp.int32), nf[:, None], axis=1)[:, 0]
-            splitting = trees["is_split"][node] & active
+            # route rows into children (one-hot lookups: XLA per-row
+            # gathers cost ~7ns/row even from a 127-entry table)
+            T_all = trees["split_feat"].shape[0]
+            nf, thr, isp, _ = _tree_lookup(node, trees, T_all)
+            bv = _binned_at(binned, nf, F)
+            splitting = isp & active
             node = jnp.where(splitting,
                              2 * node + 1 + (bv > thr).astype(jnp.int32),
                              node)
@@ -384,24 +405,43 @@ class GbdtLearner:
         return level_step
 
     # -- boosting -----------------------------------------------------------
-    def _build_tree(self, ds: BinnedDataset, g, h):
+    def _fused_round_fn(self):
+        """One jitted call per boosting round: grad/hess, every tree
+        level, and the margin update in a single dispatch. The per-level
+        steps are all static-shape, so the whole depth unrolls into one
+        XLA program — one dispatch round-trip per boosting round instead
+        of ~9 (a ~5x round-time cut at the HIGGS bench shape before the
+        histogram/routing kernels; PERF.md has the corrected table)."""
+        key = ("fused_round", self._hyper_key())
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
         cfg = self.cfg
         T = 2 ** (cfg.max_depth + 1) - 1
-        rep = replicated(self.mesh)
-        trees = {
-            "split_feat": jax.device_put(jnp.zeros(T, jnp.int32), rep),
-            "split_bin": jax.device_put(jnp.zeros(T, jnp.int32), rep),
-            "is_split": jax.device_put(jnp.zeros(T, jnp.bool_), rep),
-            "leaf_value": jax.device_put(jnp.zeros(T, jnp.float32), rep),
-        }
-        node = jnp.zeros(ds.label.shape, jnp.int32)
-        node = jax.device_put(node, batch_sharding(self.mesh, 1))
-        active = ds.mask > 0
-        for d in range(cfg.max_depth + 1):
-            num_nodes, offset = 2 ** d, 2 ** d - 1
-            fn = self._level_fn(num_nodes, offset, last=(d == cfg.max_depth))
-            node, active, trees = fn(ds.binned, g, h, node, active, trees)
-        return trees, node
+
+        @jax.jit
+        def round_fn(binned, label, mask, margin):
+            g, h = self._grad_hess(margin, label, mask)
+            trees = {
+                "split_feat": jnp.zeros(T, jnp.int32),
+                "split_bin": jnp.zeros(T, jnp.int32),
+                "is_split": jnp.zeros(T, jnp.bool_),
+                "leaf_value": jnp.zeros(T, jnp.float32),
+            }
+            node = jnp.zeros(label.shape, jnp.int32)
+            active = mask > 0
+            for d in range(cfg.max_depth + 1):
+                num_nodes, offset = 2 ** d, 2 ** d - 1
+                fn_l = self._level_fn(num_nodes, offset,
+                                      last=(d == cfg.max_depth))
+                node, active, trees = fn_l(binned, g, h, node, active,
+                                           trees)
+            _, _, _, leaf = _tree_lookup(node, trees, T)
+            margin2 = margin + leaf
+            return trees, node, margin2
+
+        self._jit_cache[key] = round_fn
+        return round_fn
 
     def _round_fns(self):
         key = ("round", self._hyper_key())
@@ -447,7 +487,7 @@ class GbdtLearner:
         self.trees = _empty_trees(cfg)
         for k in self.trees:
             self.trees[k][:r0] = prior[k][:r0]
-        gh, upd = self._round_fns()
+        _, upd = self._round_fns()
         margin = self._base_margins(train)
         margins = {name: self._base_margins(ds)
                    for name, ds in evals if ds is not train}
@@ -459,12 +499,12 @@ class GbdtLearner:
                     margins[name] = upd(margins[name], tree["leaf_value"],
                                         self._route(ds, tree))
         last = {}
+        round_fn = self._fused_round_fn()
         for r in range(r0, cfg.num_round):
-            g, hss = gh(margin, train.label, train.mask)
-            tree, node = self._build_tree(train, g, hss)
+            tree, node, margin = round_fn(train.binned, train.label,
+                                          train.mask, margin)
             for k in self.trees:
                 self.trees[k][r] = np.asarray(tree[k])
-            margin = upd(margin, tree["leaf_value"], node)
             msgs = []
             for name, ds in evals:
                 if ds is train:
@@ -493,13 +533,17 @@ class GbdtLearner:
             @jax.jit
             def route(binned, sf, sb, isp):
                 node = jnp.zeros(binned.shape[0], jnp.int32)
+                F = binned.shape[1]
+                trees_v = {"split_feat": sf, "split_bin": sb,
+                           "is_split": isp,
+                           "leaf_value": jnp.zeros_like(sf, jnp.float32)}
 
                 def body(_, node):
-                    f = sf[node]
-                    bv = jnp.take_along_axis(
-                        binned.astype(jnp.int32), f[:, None], 1)[:, 0]
-                    child = 2 * node + 1 + (bv > sb[node]).astype(jnp.int32)
-                    return jnp.where(isp[node], child, node)
+                    f, sb_n, isp_n, _ = _tree_lookup(node, trees_v,
+                                                     sf.shape[0])
+                    bv = _binned_at(binned, f, F)
+                    child = 2 * node + 1 + (bv > sb_n).astype(jnp.int32)
+                    return jnp.where(isp_n, child, node)
 
                 return jax.lax.fori_loop(0, depth + 1, body, node)
 
@@ -593,6 +637,45 @@ class GbdtLearner:
         self.cfg.base_score = float(st["base_score"])
         self.trees = {k: np.array(st[k]) for k in
                       ("split_feat", "split_bin", "is_split", "leaf_value")}
+
+
+def _tree_lookup(node, trees, T: int):
+    """Per-row lookups into the (T,)-sized tree arrays as one one-hot
+    matmul — XLA's per-row gather from even a tiny table costs ~7ns/row
+    on TPU (~14ms at the 2M-row HIGGS shape), the dominant cost of
+    routing. Every channel must survive the bf16 encoding exactly:
+    split_feat can exceed 256 (bf16's exact-integer limit), so it rides
+    as hi/lo bytes (exact for dim < 65536); split_bin is < 256 (uint8
+    bins); leaf values go through a bf16 hi/lo split (~f32 precision).
+    Returns (split_feat, split_bin, is_split, leaf_value) per row."""
+    oh = (node[:, None]
+          == jnp.arange(T, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
+    lv = trees["leaf_value"]
+    lv_hi = lv.astype(jnp.bfloat16)
+    lv_lo = (lv - lv_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    sf = trees["split_feat"]
+    tab = jnp.stack([
+        (sf >> 8).astype(jnp.bfloat16),
+        (sf & 255).astype(jnp.bfloat16),
+        trees["split_bin"].astype(jnp.bfloat16),
+        trees["is_split"].astype(jnp.bfloat16),
+        lv_hi, lv_lo,
+    ], axis=1)                                      # (T, 6)
+    got = jax.lax.dot_general(
+        oh, tab, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # (n, 6)
+    nf = (got[:, 0].astype(jnp.int32) << 8) | got[:, 1].astype(jnp.int32)
+    thr = got[:, 2].astype(jnp.int32)
+    isp = got[:, 3] > 0.5
+    leaf = got[:, 4] + got[:, 5]
+    return nf, thr, isp, leaf
+
+
+def _binned_at(binned, nf, F: int):
+    """binned[i, nf[i]] as a one-hot masked sum (take_along_axis's
+    per-row gather costs ~30ms at the HIGGS shape)."""
+    oh = nf[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]
+    return jnp.sum(jnp.where(oh, binned.astype(jnp.int32), 0), axis=1)
 
 
 def _empty_trees(cfg: GbdtConfig) -> dict[str, np.ndarray]:
