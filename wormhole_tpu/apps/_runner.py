@@ -333,16 +333,20 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
                     "likely died at startup")
             time.sleep(0.2)
         ps = PSClient(s["uris"])
+        learner.track_touched = hasattr(learner, "collect_touched")
         synced = SyncedStore(
             _store(learner), ps,
             max_delay=getattr(cfg, "max_delay", 16),
             fixed_bytes=getattr(cfg, "fixed_bytes", 0),
-            derived=getattr(learner, "derived_tables", dict)())
+            derived=getattr(learner, "derived_tables", dict)(),
+            touched_fn=getattr(learner, "collect_touched", None),
+            compress=bool(getattr(cfg, "msg_compression", 0)))
         synced.init()
     solver = MinibatchSolver(learner, cfg, verbose=False)
     if synced is not None:
         synced.perf = solver.perf
     result = {}
+    last_train = None  # (nex, seconds) of the last train round (warm)
     while (rnd := pool.sync_round()) is not None:
         wtype = WorkType(rnd["type"])
         if synced is not None:
@@ -356,9 +360,21 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
                 # (every worker just pulled the same state; one reporter
                 # avoids N-fold overcounting)
                 client.report({"new_w": float(learner.nnz())})
+        t_rnd = time.perf_counter()
         prog = _drain_round(solver, learner, pool, wtype, rnd["data_pass"],
                             synced)
+        if wtype == WorkType.TRAIN:
+            last_train = (prog.value("nex"), time.perf_counter() - t_rnd)
         result["train" if wtype == WorkType.TRAIN else "val"] = prog
+    if synced is not None and last_train is not None:
+        # machine-readable wire accounting (the sparse-PS bench parses
+        # this; wire bytes/sync is the measured sparse-wire claim)
+        import json as _json
+
+        stats = dict(synced.wire_stats(), rank=env.rank,
+                     last_round_nex=last_train[0],
+                     last_round_sec=round(last_train[1], 3))
+        print(f"[ps-wire] {_json.dumps(stats)}", flush=True)
     if synced is None:
         if cfg.model_out and env.rank == 0:
             # replica mode: single writer (rank 0) saves its full model

@@ -112,6 +112,7 @@ class _CombinedStore:
         self.mesh = stores[0].mesh
 
     on_load = None  # callback fired after from_numpy (count-mirror sync)
+    on_sparse_pull = None  # callback fired with {table: (idx, rows)}
 
     def to_numpy(self):
         out = {}
@@ -128,6 +129,18 @@ class _CombinedStore:
             s.from_numpy(own)
         if self.on_load is not None:
             self.on_load()
+
+    def _sub(self, name):
+        for s in self.stores:
+            if name in s.state:
+                return s
+        raise KeyError(name)
+
+    def gather_rows(self, name, idx):
+        return self._sub(name).gather_rows(name, idx)
+
+    def scatter_rows(self, name, idx, vals):
+        self._sub(name).scatter_rows(name, idx, vals)
 
     @property
     def state(self):
@@ -184,12 +197,23 @@ class DifactoLearner:
                and cfg.dim & (cfg.dim - 1) == 0 and 128 % cfg.dim == 0
                and (cfg.vb * cfg.dim) % ck.TILE == 0
                # the fused w update streams whole (TILE_HI, 128) tiles
-               and cfg.num_buckets % ck.TILE == 0)
+               and cfg.num_buckets % ck.TILE == 0
+               # the row-gather kernels compute flat int32 offsets
+               # uniq * dim, so the flat V table must fit int32
+               # (ADVICE r2; pack_tile_coo asserts the same for w)
+               and cfg.vb * cfg.dim < 2**31)
         self._fm_caps = None
         self._fm_steps = None
         self._fm_lock = threading.Lock()
         self._cnt_host = np.zeros(cfg.num_buckets, np.float32)
         self.ckpt_store.on_load = self.refresh_count_mirror
+        self.ckpt_store.on_sparse_pull = self._on_sparse_pull
+        # sparse PS wire hints: unique w-space / V-space rows touched by
+        # trained batches since the last collect_touched() drain
+        self.track_touched = False
+        self._touched_lock = threading.Lock()
+        self._touched_w: list[np.ndarray] = []
+        self._touched_v: list[np.ndarray] = []
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def train_step(state, vstate, seg, idx, vidx, val, label, mask, rngkey):
@@ -510,7 +534,14 @@ class DifactoLearner:
         pk = self._pack_fm(db, train)
         args = tuple(jax.device_put(a) for a in
                      self._fm_args(pk, db.label, db.row_mask, train))
-        return ("fm", args, blk.size, train)
+        ids = None
+        if train and self.track_touched:
+            # host-side touched rows for the sparse PS wire, extracted
+            # before the pack moves to device (sentinel slots filtered)
+            ts_w, ts_v = pk[0], pk[3]
+            ids = (ts_w.uniq[ts_w.uniq < cfg.num_buckets].astype(np.int64),
+                   ts_v.uniq[ts_v.uniq < cfg.vb].astype(np.int64))
+        return ("fm", args, blk.size, train, ids)
 
     def _fm_args(self, pk, label, mask, train: bool):
         ts_w, wcnts, wcoo, ts_v, vtouched, vcoo = pk
@@ -568,20 +599,63 @@ class DifactoLearner:
         b = self._prepared(blk, train=True)
         self._rng, sub = jax.random.split(self._rng)
         if b[0] == "fm":
-            _, args, _, _ = b
+            args = b[1]
             self.store.state, self.vstore.state, prog = self._fm_steps[0](
                 self.store.state, self.vstore.state, *args, sub)
+            if self.track_touched:
+                self._note_touched(b[4])
         else:
+            db = b[1]
             self.store.state, self.vstore.state, prog = self._train_step(
                 self.store.state, self.vstore.state,
-                *self._xla_args(b[1]), sub)
+                *self._xla_args(db), sub)
+            if self.track_touched:
+                ids_w = np.unique(db.idx[db.val != 0]).astype(np.int64)
+                self._note_touched((ids_w, ids_w % self.cfg.vb))
         self._step_count += 1
         return jax.tree_util.tree_map(float, prog)
+
+    # -- sparse PS wire hints ------------------------------------------------
+    def _note_touched(self, ids) -> None:
+        if ids is None:
+            ids = (None, None)
+        with self._touched_lock:
+            self._touched_w.append(ids[0])
+            self._touched_v.append(ids[1])
+
+    def collect_touched(self):
+        """Sorted-unique global rows touched since the last call, per
+        table (the sparse PS push set; reference ZPush of the
+        minibatch's keys, async_sgd.h:270-287). Returns None if any
+        trained batch lacked a hint (SyncedStore then falls back to a
+        full delta scan for this sync)."""
+        with self._touched_lock:
+            tw, tv = self._touched_w, self._touched_v
+            self._touched_w, self._touched_v = [], []
+        if any(a is None for a in tw):
+            return None
+        uw = (np.unique(np.concatenate(tw)) if tw
+              else np.empty(0, np.int64))
+        uv = (np.unique(np.concatenate(tv)) if tv
+              else np.empty(0, np.int64))
+        out = {k: uw for k in self.store.state}
+        out.update({k: uv for k in self.vstore.state})
+        return out
+
+    def _on_sparse_pull(self, updates) -> None:
+        """Keep the host count mirror coherent with sparse PS pulls (the
+        dense path refreshes it via on_load/from_numpy)."""
+        got = updates.get("cnt")
+        if got is None:
+            return
+        idx, rows = got
+        with self._fm_lock:
+            self._cnt_host[idx] = rows
 
     def _fwd_any(self, blk):
         b = self._prepared(blk, train=False)
         if b[0] == "fm":
-            _, args, size, _ = b
+            args, size = b[1], b[2]
             margin, prog = self._fm_steps[1](
                 self.store.state, self.vstore.state, *args)
         else:

@@ -70,6 +70,9 @@ class LinearConfig:
     rand_shuffle: int = 0  # shuffle buffer in minibatches (0 = off)
     neg_sampling: float = 1.0
     fixed_bytes: int = 0  # gradient-push quantization filter
+    # zlib-compress the PS delta stream (the reference's msg_compression
+    # filter, config.proto:123-133; COMPRESSING in async_sgd.h:290-301)
+    msg_compression: int = 0
     # bounded staleness (reference config.proto:122 max_delay,
     # criteo.conf:21): in the multi-process launch, the max number of
     # minibatches a worker trains between syncs against the server group
@@ -123,8 +126,10 @@ class LinearConfig:
     # table values and per-nnz gradients round to bfloat16) | f32 (exact,
     # matches kernel=xla numerics) | auto (f32 when fixed_bytes == 0 —
     # i.e. when gradient quantization is nominally off the kernel does not
-    # silently re-introduce rounding — else bf16)
-    kernel_dtype: str = "bf16"
+    # silently re-introduce rounding — else bf16). Default "auto": default
+    # numerics match the XLA path; bf16 is the documented opt-in for the
+    # extra throughput (VERDICT r2 #8; both measured in PERF.md).
+    kernel_dtype: str = "auto"
 
     @property
     def row_capacity(self) -> int:
@@ -367,6 +372,11 @@ class LinearLearner:
         self._compact_lock = threading.Lock()
         if self._mesh_coo or not self.use_pallas or cfg.compact_cap == 0:
             self._compact_cap = 0
+        # sparse PS wire hints: unique buckets touched by trained batches
+        # since the last collect_touched() drain (runtime/ps_server)
+        self.track_touched = False
+        self._touched_lock = threading.Lock()
+        self._touched: list[Optional[np.ndarray]] = []
 
     # -- global-mesh SPMD protocol (apps/_runner._global_train) ------------
     def global_step_protocol(self):
@@ -532,8 +542,44 @@ class LinearLearner:
             x = self.prepare_batch(x)
         return x
 
+    # -- sparse PS wire hints ------------------------------------------------
+    def _note_touched(self, b) -> None:
+        """Record the unique buckets a trained batch touched, extracted
+        from the prepared batch's host arrays (the sparse PS push set;
+        reference ZPush of the minibatch's keys, async_sgd.h:270-287)."""
+        kind = b[0]
+        if kind == "xla":
+            db = b[1]
+            ids = np.unique(db.idx[db.val != 0])
+        elif kind == "coo":
+            p = b[1]
+            ids = np.unique(p.idx[p.val != 0])
+        elif kind == "tcoo":
+            u = b[1].uniq
+            ids = u[u < self.cfg.num_buckets]
+        else:  # mcoo holds shard-local layouts; fall back to the scan
+            ids = None
+        with self._touched_lock:
+            self._touched.append(
+                None if ids is None else ids.astype(np.int64))
+
+    def collect_touched(self):
+        """Sorted-unique global rows touched since the last call, per
+        table, or None if any batch lacked a hint (SyncedStore then
+        falls back to a full delta scan for this sync)."""
+        with self._touched_lock:
+            acc = self._touched
+            self._touched = []
+        if any(a is None for a in acc):
+            return None
+        u = (np.unique(np.concatenate(acc)) if acc
+             else np.empty(0, np.int64))
+        return {k: u for k in self.store.state}
+
     def train_batch(self, blk) -> dict:
         b = self._prepared(blk)
+        if self.track_touched:
+            self._note_touched(b)
         if b[0] == "mcoo":
             _, mc, label, mask, _ = b
             self.store.state, prog = self._train_step_mcoo(

@@ -481,7 +481,9 @@ def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
 
     new_slot = ts.slot_of_uniq[loc.local_index]
     keep = new_slot < u_cap
-    dropped_nnz = int(np.count_nonzero(~keep))
+    # count only real (nonzero-valued) dropped entries: padding triples
+    # carry val == 0 and losing them loses nothing (ADVICE r2)
+    dropped_nnz = int(np.count_nonzero(~keep & (val != 0)))
     p = pack_sorted_coo(new_slot[keep], seg[keep], val[keep], u_cap,
                         capacity=capacity)
     return TileCOO(ts.uniq, p, ts.tmap_u, ts.first_u, ts.last_u,

@@ -19,8 +19,12 @@ Semantics match models/linear._update exactly:
   tile update is masked by g != 0 (the touched mask).
 - fixed_bytes: the push-quantization filter applies to the scattered
   gradient before the update; the int8 mode's absmax scale is computed
-  over the WHOLE compact gradient outside the kernel and passed in, so
-  numerics match parallel.kvstore.quantize_push bit-for-bit.
+  over the WHOLE compact gradient outside the kernel and passed in.
+  With dtype=f32 numerics match parallel.kvstore.quantize_push
+  bit-for-bit; with the bf16 MXU dtype the scatter matmul rounds the
+  gradient to bfloat16 BEFORE _quantize runs, so int8 parity is only
+  approximate there (bf16-of-int8-steps) — quantized + bf16 composes
+  two roundings by design.
 """
 
 from __future__ import annotations

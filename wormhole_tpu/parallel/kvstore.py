@@ -78,6 +78,8 @@ class KVStore:
                     out_shardings=sh,
                 )()
             self.state[name] = arr
+        self._gather_fn = jax.jit(lambda a, i: a[i])
+        self._scatter_fns: dict[str, Callable] = {}
 
     # -- helpers used inside learner-jitted steps ---------------------------
     def sharding(self, name: str):
@@ -94,6 +96,52 @@ class KVStore:
     def update(self, new_state: dict[str, jax.Array]) -> None:
         assert set(new_state) == set(self.state), "state keys changed"
         self.state = new_state
+
+    # -- sparse host<->device row access (the PS data plane's unit) ---------
+    # Row-index lengths vary per sync; padding to the next power of two
+    # bounds XLA retraces to O(log max-touched) compiled shapes.
+    @staticmethod
+    def _pad_pow2(idx: np.ndarray, fill: int) -> tuple[np.ndarray, int]:
+        n = int(idx.shape[0])
+        m = 8
+        while m < n:
+            m <<= 1
+        out = np.full(m, fill, dtype=np.int64)
+        out[:n] = idx
+        return out, n
+
+    def gather_rows(self, name: str, idx: np.ndarray) -> np.ndarray:
+        """Fetch rows `idx` of a table to host — a device gather plus an
+        O(touched) transfer, never a full-table copy (the ZPush side of
+        the sparse PS wire reads current values this way)."""
+        if idx.size == 0:
+            tail = self.state[name].shape[1:]
+            return np.empty((0, *tail), np.float32)
+        pad, n = self._pad_pow2(np.asarray(idx), 0)
+        out = self._gather_fn(self.state[name], jnp.asarray(pad))
+        return np.asarray(out[:n], dtype=np.float32)
+
+    def scatter_rows(self, name: str, idx: np.ndarray,
+                     vals: np.ndarray) -> None:
+        """Overwrite rows `idx` with `vals` in place on device (the
+        sparse pull apply). Padding rows use an out-of-range index and
+        mode='drop', so they never land."""
+        if idx.size == 0:
+            return
+        fn = self._scatter_fns.get(name)
+        if fn is None:
+            sh = self.sharding(name)
+            fn = jax.jit(
+                lambda a, i, v: jax.lax.with_sharding_constraint(
+                    a.at[i].set(v, mode="drop"), sh),
+                donate_argnums=0)
+            self._scatter_fns[name] = fn
+        pad, n = self._pad_pow2(np.asarray(idx), self.state[name].shape[0])
+        tail = self.state[name].shape[1:]
+        v = np.zeros((pad.shape[0], *tail), np.float32)
+        v[:n] = vals
+        self.state[name] = fn(self.state[name], jnp.asarray(pad),
+                              jnp.asarray(v))
 
     # -- host-side views ----------------------------------------------------
     def nnz(self, name: str = "w") -> int:

@@ -18,11 +18,23 @@ This module is the TPU build's cross-process equivalent:
   async-SGD semantics with staleness <= max_delay minibatches per worker
   (the reference's max_delay knob, difacto guide/criteo.conf:21, bounds
   the same quantity in units of in-flight minibatches).
-- The wire is a length-prefixed binary protocol over TCP; pushes are
-  optionally quantized on the wire (fixed_bytes: 2 = bfloat16 bits,
-  1 = int8 + scale — the FIXING_FLOAT/TRUNCATE filter parity,
-  async_sgd.h:290-301) so the filter actually reduces bandwidth, not
-  just rounding.
+- **The wire is sparse**: a push carries only the rows the worker
+  touched since its last sync — (indices, delta-rows) per table — the
+  ZPush-of-the-minibatch's-keys semantic (async_sgd.h:270-287). Pulls
+  are versioned: servers stamp every pushed row with a monotonically
+  increasing clock, and `pull since=c` returns only rows stamped after
+  `c` — so a worker's pull traffic is proportional to what ANY worker
+  changed since it last looked, never to the table size. Together these
+  make wire bytes/sync O(globally touched keys), which is what lets the
+  multi-process path run at the 2^26-bucket Criteo-1TB operating point
+  (a dense (z, n) sync there would be ~0.5 GB per worker per sync).
+- Pushes are optionally quantized on the wire (fixed_bytes: 2 = bfloat16
+  bits, 1 = int8 + scale — the FIXING_FLOAT/TRUNCATE filter parity,
+  async_sgd.h:290-301) and optionally zlib-compressed (the
+  msg_compression filter, linear config.proto:123-133). The reference's
+  third filter, KEY_CACHING, avoids resending identical key lists; the
+  sparse wire sends each sync's touched-index set exactly once per
+  table-group already, so there is no repeated key list to cache.
 
 Server discovery rides the scheduler control plane: servers register
 their URI (op=register_server), workers poll op=servers until all `-s`
@@ -37,7 +49,8 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Optional
+import zlib
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -47,37 +60,61 @@ from wormhole_tpu.runtime.net import connect_with_retry
 # Frame = 4-byte big-endian header length | JSON header | raw payload.
 # header = {"op": str, ...meta, "arrays": [{"name", "shape", "enc",
 #           "scale", "nbytes"}, ...]}; payload = buffers concatenated in
-# array order.
+# array order. Integer arrays (sparse-push/pull row indices) ride the
+# same frame with enc="i32"/"i64"; "comp": "zlib" marks a compressed
+# buffer ("nbytes" is then the compressed size, "rawbytes" the original).
+
+_COMPRESS_MIN = 512  # don't bother compressing tiny buffers
 
 
-def _encode(a: np.ndarray, fixed_bytes: int = 0) -> tuple[dict, bytes]:
-    """Encode one f32 array for the wire. fixed_bytes: 0 = raw f32,
-    2 = bfloat16 bit-truncation (round-to-nearest-even), 1 = absmax int8."""
-    a = np.ascontiguousarray(a, dtype=np.float32)
-    meta = {"shape": list(a.shape)}
-    if fixed_bytes == 0:
+def _encode(a: np.ndarray, fixed_bytes: int = 0,
+            compress: bool = False) -> tuple[dict, bytes]:
+    """Encode one array for the wire. Float arrays honor fixed_bytes:
+    0 = raw f32, 2 = bfloat16 bit-truncation (round-to-nearest-even),
+    1 = absmax int8. Integer arrays always go raw (they are row indices;
+    rounding them would corrupt the scatter)."""
+    meta: dict = {"shape": list(a.shape)}
+    if np.issubdtype(a.dtype, np.integer):
+        a = np.ascontiguousarray(
+            a, dtype=np.int64 if a.dtype.itemsize > 4 else np.int32)
         buf = a.tobytes()
-        meta.update(enc="raw", nbytes=len(buf))
-        return meta, buf
-    if fixed_bytes >= 2:
-        u = a.view(np.uint32)
-        # round-to-nearest-even to the high 16 bits (bfloat16)
-        rounded = (u + 0x7FFF + ((u >> 16) & 1)) >> 16
-        buf = rounded.astype(np.uint16).tobytes()
-        meta.update(enc="bf16", nbytes=len(buf))
-        return meta, buf
-    scale = float(max(np.max(np.abs(a), initial=0.0), 1e-30) / 127.0)
-    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
-    buf = q.tobytes()
-    meta.update(enc="int8", scale=scale, nbytes=len(buf))
+        meta.update(enc="i64" if a.dtype == np.int64 else "i32",
+                    nbytes=len(buf))
+    else:
+        a = np.ascontiguousarray(a, dtype=np.float32)
+        if fixed_bytes == 0:
+            buf = a.tobytes()
+            meta.update(enc="raw", nbytes=len(buf))
+        elif fixed_bytes >= 2:
+            u = a.view(np.uint32)
+            # round-to-nearest-even to the high 16 bits (bfloat16)
+            rounded = (u + 0x7FFF + ((u >> 16) & 1)) >> 16
+            buf = rounded.astype(np.uint16).tobytes()
+            meta.update(enc="bf16", nbytes=len(buf))
+        else:
+            scale = float(max(np.max(np.abs(a), initial=0.0), 1e-30) / 127.0)
+            q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+            buf = q.tobytes()
+            meta.update(enc="int8", scale=scale, nbytes=len(buf))
+    if compress and len(buf) >= _COMPRESS_MIN:
+        c = zlib.compress(buf, 1)
+        if len(c) < len(buf):
+            meta.update(comp="zlib", rawbytes=meta["nbytes"], nbytes=len(c))
+            buf = c
     return meta, buf
 
 
 def _decode(meta: dict, buf: bytes) -> np.ndarray:
     shape = tuple(meta["shape"])
     enc = meta["enc"]
+    if meta.get("comp") == "zlib":
+        buf = zlib.decompress(buf)
     if enc == "raw":
         return np.frombuffer(buf, np.float32).reshape(shape).copy()
+    if enc == "i32":
+        return np.frombuffer(buf, np.int32).reshape(shape).copy()
+    if enc == "i64":
+        return np.frombuffer(buf, np.int64).reshape(shape).copy()
     if enc == "bf16":
         u = np.frombuffer(buf, np.uint16).astype(np.uint32) << 16
         return u.view(np.float32).reshape(shape).copy()
@@ -100,10 +137,12 @@ def _read_exact(sock_file, n: int) -> Optional[bytes]:
 
 def send_frame(sock_file, header: dict,
                arrays: Optional[dict[str, np.ndarray]] = None,
-               fixed_bytes: int = 0) -> None:
+               fixed_bytes: int = 0, compress: bool = False) -> int:
+    """Write one frame; returns the number of payload+header bytes sent
+    (the wire-accounting unit PSClient reports)."""
     metas, bufs = [], []
     for name, a in (arrays or {}).items():
-        m, b = _encode(a, fixed_bytes)
+        m, b = _encode(a, fixed_bytes, compress)
         m["name"] = name
         metas.append(m)
         bufs.append(b)
@@ -111,12 +150,15 @@ def send_frame(sock_file, header: dict,
     h = json.dumps(header).encode()
     sock_file.write(struct.pack(">I", len(h)))
     sock_file.write(h)
+    total = 4 + len(h)
     for b in bufs:
         sock_file.write(b)
+        total += len(b)
     sock_file.flush()
+    return total
 
 
-def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray]]]:
+def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
     raw = _read_exact(sock_file, 4)
     if raw is None:
         return None
@@ -125,19 +167,28 @@ def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray]]]:
     if h is None:
         return None
     header = json.loads(h)
+    total = 4 + hlen
     arrays = {}
     for m in header.get("arrays", []):
         buf = _read_exact(sock_file, m["nbytes"])
         if buf is None:
             return None
+        total += m["nbytes"]
         arrays[m["name"]] = _decode(m, buf)
-    return header, arrays
+    return header, arrays, total
 
 
 def shard_range(n: int, rank: int, world: int) -> tuple[int, int]:
     """Row range of server `rank`: the same even split checkpoint part
     files use (utils/checkpoint.py), so parts reassemble by rank order."""
     return n * rank // world, n * (rank + 1) // world
+
+
+def _idx_name(rows: int) -> str:
+    """Wire name of the shared index array for the row-space group of
+    tables with `rows` full rows (tables with equal row counts share one
+    touched-index set per frame — z and n are always touched together)."""
+    return f"idx:{rows}"
 
 
 # ---------------------------------------------------------------- server
@@ -147,10 +198,11 @@ class _PSHandler(socketserver.StreamRequestHandler):
             got = recv_frame(self.rfile)
             if got is None:
                 return
-            header, arrays = got
+            header, arrays, _ = got
             resp_header, resp_arrays = self.server.node._dispatch(  # type: ignore
                 header, arrays)
-            send_frame(self.wfile, resp_header, resp_arrays)
+            send_frame(self.wfile, resp_header, resp_arrays,
+                       compress=bool(header.get("comp_reply")))
             if header.get("op") == "shutdown":
                 self.server.node._shutdown.set()  # type: ignore
                 return
@@ -165,8 +217,15 @@ class ServerNode:
     """One `-s` server process: owns its bucket-range slice of every state
     table. Tables are created by the first `init` push (set-if-absent;
     workers init deterministically so any winner is equivalent); `push`
-    adds deltas; `pull` returns current slices; `save` writes this
-    server's shard as a checkpoint part file."""
+    adds deltas — sparse (rows at pushed indices) or dense; `pull`
+    returns rows stamped after the caller's `since` clock; `save` writes
+    this server's shard as a checkpoint part file.
+
+    Versioning: every push advances `clock` and stamps the pushed rows
+    in a per-row-space version array (`_ver[full_rows][row] = clock`).
+    Tables with the same full row count form one group and share a
+    version array — pushing z also makes the derived w's rows dirty,
+    which is exactly right since w = prox(z, n)."""
 
     def __init__(self, rank: int, world: int,
                  host: str = "127.0.0.1", port: int = 0):
@@ -179,7 +238,11 @@ class ServerNode:
         # additive ones (FTRL's w = prox(z, n)); recomputed server-side
         # after merges so pulls/saves never expose an inconsistent pair
         self.derived: dict[str, dict] = {}
-        self._derived_dirty = False
+        self.clock = 0
+        self._ver: dict[int, np.ndarray] = {}  # group -> int64[shard rows]
+        # rows dirty since the last derived recompute, per group:
+        # list of shard-local index arrays, or "all" after a dense push
+        self._dirty: dict[int, object] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         self._srv = _PSServer((host, port), _PSHandler)
@@ -204,6 +267,10 @@ class ServerNode:
         self._srv.shutdown()
         self._srv.server_close()
 
+    def _shard_rows(self, group: int) -> int:
+        lo, hi = shard_range(group, self.rank, self.world)
+        return hi - lo
+
     # -- ops ----------------------------------------------------------------
     def _dispatch(self, header: dict, arrays: dict) -> tuple[dict, dict]:
         op = header.get("op")
@@ -216,33 +283,72 @@ class ServerNode:
                     self.full_rows = {
                         k: int(n) for k, n in header["full_rows"].items()}
                     self.derived = header.get("derived") or {}
-            return {"ok": True, "known": known}, {}
+                    for g in {r for r in self.full_rows.values()}:
+                        # uint32 clock stamps: 4 bytes/row; wraps only
+                        # after 2^32 pushes (unreachable in practice)
+                        self._ver[g] = np.zeros(self._shard_rows(g),
+                                                np.uint32)
+                        self._dirty[g] = []
+                return ({"ok": True, "known": known, "clock": self.clock},
+                        {})
         if op == "pull":
+            since = header.get("since")
+            if since is None:
+                with self._lock:
+                    self.num_pull += 1
+                    self._recompute_derived()
+                    out = {k: v.copy() for k, v in self.tables.items()}
+                    return {"ok": True, "clock": self.clock}, out
             with self._lock:
                 self.num_pull += 1
                 self._recompute_derived()
-                out = {k: v.copy() for k, v in self.tables.items()}
-            return {"ok": True}, out
+                out = {}
+                for g, ver in self._ver.items():
+                    idx = np.flatnonzero(ver > since)
+                    out[_idx_name(g)] = idx.astype(np.int64)
+                    for k, rows in self.full_rows.items():
+                        if rows == g:
+                            out[k] = self.tables[k][idx]
+                return {"ok": True, "clock": self.clock}, out
         if op == "push":
             with self._lock:
                 self.num_push += 1
+                self.clock += 1
+                idx_of = {g: arrays[_idx_name(g)]
+                          for g in self._ver if _idx_name(g) in arrays}
                 for k, d in arrays.items():
+                    if k.startswith("idx:"):
+                        continue
                     if k not in self.tables:
                         return {"error": f"push to unknown table {k}"}, {}
                     if k in self.derived:
                         # non-additive derived tables ignore pushed deltas;
                         # they are recomputed from their additive sources
                         continue
-                    self.tables[k] += d
-                self._derived_dirty = True
-            return {"ok": True}, {}
+                    g = self.full_rows[k]
+                    idx = idx_of.get(g)
+                    if idx is None:
+                        self.tables[k] += d
+                    else:
+                        # worker-side indices are unique (np.unique
+                        # output), so fancy += is a correct scatter-add
+                        self.tables[k][idx] += d
+                for g, idx in idx_of.items():
+                    self._ver[g][idx] = self.clock
+                    if self._dirty.get(g) != "all":
+                        self._dirty.setdefault(g, []).append(idx)
+                if not idx_of:  # dense push: everything is dirty
+                    for g in self._ver:
+                        self._ver[g][:] = self.clock
+                        self._dirty[g] = "all"
+                return {"ok": True, "clock": self.clock}, {}
         if op == "save":
             path = self._save(header["base"], header.get("iter"))
             return {"ok": True, "path": path}, {}
         if op == "stats":
             with self._lock:
                 return {"ok": True, "num_push": self.num_push,
-                        "num_pull": self.num_pull,
+                        "num_pull": self.num_pull, "clock": self.clock,
                         "tables": {k: list(v.shape)
                                    for k, v in self.tables.items()}}, {}
         if op == "shutdown":
@@ -250,24 +356,34 @@ class ServerNode:
         return {"error": f"unknown op {op!r}"}, {}
 
     def _recompute_derived(self) -> None:
-        """Recompute derived tables from their additive sources (caller
-        holds the lock). FTRL's w is soft-threshold-nonlinear in (z, n),
-        so additively merged worker deltas cannot represent it: a key
-        whose merged z crosses the L1 threshold must re-solve the prox
-        even though every worker pushed delta-w = 0."""
-        if not self._derived_dirty:
-            return
+        """Recompute derived tables from their additive sources over the
+        rows dirtied since the last recompute (caller holds the lock).
+        FTRL's w is soft-threshold-nonlinear in (z, n), so additively
+        merged worker deltas cannot represent it: a key whose merged z
+        crosses the L1 threshold must re-solve the prox even though
+        every worker pushed delta-w = 0. Restricting the prox to dirty
+        rows keeps server work O(touched keys), not O(table)."""
         for k, spec in self.derived.items():
-            if spec["kind"] == "ftrl_prox":
-                z, n = self.tables["z"], self.tables["n"]
-                eta = (spec["lr_beta"] + np.sqrt(n)) / spec["lr_eta"]
-                mag = np.maximum(np.abs(z) - spec["lambda_l1"], 0.0)
-                self.tables[k] = (np.sign(-z) * mag
-                                  / (eta + spec["lambda_l2"])
-                                  ).astype(np.float32)
-            else:
+            g = self.full_rows[k]
+            dirty = self._dirty.get(g)
+            if dirty == []:
+                continue
+            if spec["kind"] != "ftrl_prox":
                 raise ValueError(f"unknown derived kind {spec['kind']!r}")
-        self._derived_dirty = False
+            if dirty == "all":
+                u = slice(None)
+            else:
+                u = np.unique(np.concatenate(dirty))
+                if u.size == 0:
+                    continue
+            z, n = self.tables["z"][u], self.tables["n"][u]
+            eta = (spec["lr_beta"] + np.sqrt(n)) / spec["lr_eta"]
+            mag = np.maximum(np.abs(z) - spec["lambda_l1"], 0.0)
+            self.tables[k][u] = (np.sign(-z) * mag
+                                 / (eta + spec["lambda_l2"])
+                                 ).astype(np.float32)
+        for g in self._dirty:
+            self._dirty[g] = []
 
     def _save(self, base: str, it: Optional[int]) -> str:
         import glob
@@ -303,7 +419,10 @@ class ServerNode:
 # ---------------------------------------------------------------- client
 class PSClient:
     """Worker-side stub over all servers: splits each table by the
-    servers' row ranges, keeps one persistent connection per server."""
+    servers' row ranges, keeps one persistent connection per server.
+    Tracks wire bytes (bytes_push / bytes_pull, both directions) so the
+    sparse-wire claim — bytes/sync proportional to touched keys — is a
+    measured quantity, not an assumption."""
 
     def __init__(self, uris: list[str], connect_deadline: float = 30.0):
         self.uris = list(uris)
@@ -311,6 +430,9 @@ class PSClient:
         self._socks: list[Optional[socket.socket]] = [None] * self.world
         self._files = [None] * self.world
         self.connect_deadline = connect_deadline
+        self.full_rows: dict[str, int] = {}
+        self.bytes_push = 0
+        self.bytes_pull = 0
 
     def _file(self, r: int):
         if self._files[r] is None:
@@ -320,10 +442,13 @@ class PSClient:
             self._files[r] = s.makefile("rwb")
         return self._files[r]
 
-    def _rpc(self, r: int, header: dict, arrays=None, fixed_bytes: int = 0):
+    def _rpc(self, r: int, header: dict, arrays=None, fixed_bytes: int = 0,
+             compress: bool = False):
         f = self._file(r)
+        if compress:
+            header = dict(header, comp_reply=1)
         try:
-            send_frame(f, header, arrays, fixed_bytes)
+            sent = send_frame(f, header, arrays, fixed_bytes, compress)
             got = recv_frame(f)
         except OSError:
             self.close(r)
@@ -331,9 +456,14 @@ class PSClient:
         if got is None:
             self.close(r)
             raise ConnectionResetError(f"server {self.uris[r]} closed")
-        h, arrs = got
+        h, arrs, received = got
         if "error" in h:
             raise RuntimeError(f"ps server error: {h['error']}")
+        op = header.get("op")
+        if op == "push":
+            self.bytes_push += sent + received
+        elif op == "pull":
+            self.bytes_pull += sent + received
         return h, arrs
 
     def close(self, r: Optional[int] = None) -> None:
@@ -356,26 +486,88 @@ class PSClient:
         return out
 
     def init(self, tables: dict[str, np.ndarray],
-             derived: Optional[dict] = None) -> None:
-        full_rows = {k: int(v.shape[0]) for k, v in tables.items()}
+             derived: Optional[dict] = None) -> list[int]:
+        """Offer init state to every server; returns per-server clocks
+        (a later `pull_sparse(since=these)` sees everything pushed after
+        table creation)."""
+        self.full_rows = {k: int(v.shape[0]) for k, v in tables.items()}
+        clocks = []
         for r in range(self.world):
-            self._rpc(r, {"op": "init", "full_rows": full_rows,
-                          "derived": derived or {}},
-                      self._slices(tables, r))
+            h, _ = self._rpc(r, {"op": "init", "full_rows": self.full_rows,
+                                 "derived": derived or {}},
+                             self._slices(tables, r))
+            clocks.append(int(h.get("clock", 0)))
+        return clocks
 
     def pull(self) -> dict[str, np.ndarray]:
+        """Dense full-table pull (startup / test convenience)."""
         parts = [self._rpc(r, {"op": "pull"})[1] for r in range(self.world)]
+        if not self.full_rows:
+            self.full_rows = {
+                k: sum(p[k].shape[0] for p in parts) for k in parts[0]}
         return {
             k: np.concatenate([p[k] for p in parts], axis=0)
             if self.world > 1 else parts[0][k]
             for k in parts[0]
         }
 
+    def pull_sparse(self, since: list[int], compress: bool = False,
+                    ) -> tuple[list[int], dict[int, np.ndarray],
+                               dict[str, np.ndarray]]:
+        """Versioned pull: rows stamped after `since[r]` on each server.
+        Returns (new clocks, {group_rows: global indices},
+        {table: rows aligned to its group's indices})."""
+        clocks = []
+        g_idx: dict[int, list] = {}
+        t_rows: dict[str, list] = {}
+        for r in range(self.world):
+            h, arrs = self._rpc(r, {"op": "pull", "since": int(since[r])},
+                                compress=compress)
+            clocks.append(int(h["clock"]))
+            for g in {rows for rows in self.full_rows.values()}:
+                name = _idx_name(g)
+                if name not in arrs:
+                    continue
+                lo, _ = shard_range(g, r, self.world)
+                g_idx.setdefault(g, []).append(arrs[name] + lo)
+            for k, rows in self.full_rows.items():
+                if k in arrs:
+                    t_rows.setdefault(k, []).append(arrs[k])
+        groups = {g: np.concatenate(v) if len(v) > 1 else v[0]
+                  for g, v in g_idx.items()}
+        tables = {k: np.concatenate(v, axis=0) if len(v) > 1 else v[0]
+                  for k, v in t_rows.items()}
+        return clocks, groups, tables
+
     def push(self, deltas: dict[str, np.ndarray],
              fixed_bytes: int = 0) -> None:
+        """Dense full-table delta push (test convenience / fallback)."""
         for r in range(self.world):
             self._rpc(r, {"op": "push"}, self._slices(deltas, r),
                       fixed_bytes=fixed_bytes)
+
+    def push_sparse(self, groups: dict[int, np.ndarray],
+                    deltas: dict[str, np.ndarray],
+                    fixed_bytes: int = 0, compress: bool = False) -> None:
+        """Sparse delta push. `groups` maps a row-space (full row count)
+        to the sorted-unique GLOBAL row indices touched in it;
+        `deltas[k]` holds the delta rows of table k aligned to
+        `groups[full_rows[k]]`."""
+        # per-server, per-group selection computed once and shared by all
+        # tables in the group
+        for r in range(self.world):
+            arrays: dict[str, np.ndarray] = {}
+            sel: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+            for g, idx in groups.items():
+                lo, hi = shard_range(g, r, self.world)
+                m = (idx >= lo) & (idx < hi)
+                sel[g] = (m, idx[m] - lo)
+                arrays[_idx_name(g)] = sel[g][1]
+            for k, rows in deltas.items():
+                g = self.full_rows[k]
+                arrays[k] = rows[sel[g][0]]
+            self._rpc(r, {"op": "push"}, arrays, fixed_bytes=fixed_bytes,
+                      compress=compress)
 
     def save(self, base: str, it: Optional[int] = None) -> list[str]:
         return [self._rpc(r, {"op": "save", "base": base, "iter": it})[0]
@@ -397,47 +589,150 @@ class SyncedStore:
     """Bounded-staleness synchronization of a learner's KV store against
     the server group: tracks the state at last pull and pushes additive
     deltas (cur - base). `maybe_sync` counts minibatches and syncs every
-    `max_delay` (the reference's bounded-async knob)."""
+    `max_delay` (the reference's bounded-async knob).
+
+    Sparse wire: when the learner supplies `touched_fn` (returning, per
+    additive table, the sorted-unique global rows it touched since the
+    last call) AND the store exposes `gather_rows`/`scatter_rows`, the
+    sync path never materializes a full table — it gathers the touched
+    device rows, pushes (indices, deltas), and scatters back the rows
+    the versioned pull reports dirty. Without hints it falls back to a
+    full-table delta scan (host O(table), wire still sparse: only rows
+    with nonzero delta are sent)."""
 
     def __init__(self, store, client: PSClient, max_delay: int = 16,
                  fixed_bytes: int = 0, derived: Optional[dict] = None,
-                 perf=None):
+                 perf=None, touched_fn: Optional[Callable] = None,
+                 compress: bool = False):
         self.store = store
         self.client = client
         self.perf = perf  # optional utils.perf.Perf: times push/pull ops
         self.max_delay = max(int(max_delay), 1)
         self.fixed_bytes = fixed_bytes
+        self.compress = bool(compress)
         # non-additive derived-table specs forwarded to the servers (e.g.
         # FTRL's w = prox(z, n); see ServerNode._recompute_derived)
         self.derived = derived or {}
+        self.touched_fn = touched_fn
+        self._sparse_store = (hasattr(store, "gather_rows")
+                              and hasattr(store, "scatter_rows"))
         self._base: dict[str, np.ndarray] = {}
+        self._clocks: Optional[list[int]] = None
         self._steps = 0
         self.num_syncs = 0
 
     def init(self) -> None:
         """Offer this worker's (deterministic) init state, then adopt the
-        authoritative server state."""
-        self.client.init(self.store.to_numpy(), derived=self.derived)
-        self.pull()
+        merged server state. All workers initialize identically, so the
+        local state IS the table-creation state — the startup pull only
+        needs the rows pushed since creation (since=0), never the full
+        table (at the 2^26 operating point a dense startup pull would be
+        ~0.75 GB per worker)."""
+        snap = self.store.to_numpy()
+        self.client.init(snap, derived=self.derived)
+        # writable host mirror (to_numpy may hand out read-only views of
+        # device buffers)
+        self._base = {k: np.array(v, np.float32) for k, v in snap.items()}
+        self._clocks = [0] * self.client.world
+        self._apply_pull()
+
+    def _apply_pull(self) -> None:
+        """Versioned pull: fetch rows dirty since our clocks, fold them
+        into the base mirror and the device store."""
+        clocks, groups, tables = self.client.pull_sparse(
+            self._clocks, compress=self.compress)
+        for k, rows in tables.items():
+            idx = groups[self.client.full_rows[k]]
+            if idx.size == 0:
+                continue
+            self._base[k][idx] = rows
+            if self._sparse_store:
+                self.store.scatter_rows(k, idx, rows)
+        if not self._sparse_store and groups:
+            self.store.from_numpy(self._base)
+        elif self._sparse_store:
+            # host-mirror coherence hook (e.g. difacto's admission-count
+            # mirror): the dense path refreshes mirrors via from_numpy;
+            # the sparse path hands over exactly the pulled rows
+            hook = getattr(self.store, "on_sparse_pull", None)
+            if hook is not None:
+                hook({k: (groups[self.client.full_rows[k]], rows)
+                      for k, rows in tables.items()})
+        self._clocks = clocks
 
     def pull(self) -> None:
-        pulled = self.client.pull()
-        self.store.from_numpy(pulled)
-        self._base = pulled
+        if self._clocks is None:
+            pulled = self.client.pull()
+            self.store.from_numpy(pulled)
+            self._base = pulled
+            return
+        self._apply_pull()
+
+    def _touched_groups(self):
+        """(groups, deltas) for push_sparse from learner hints, or None
+        to use the full-scan fallback."""
+        if self.touched_fn is None:
+            return None
+        touched = self.touched_fn()
+        if touched is None:
+            return None
+        groups: dict[int, np.ndarray] = {}
+        deltas: dict[str, np.ndarray] = {}
+        for k, rows in self.client.full_rows.items():
+            if k in self.derived:
+                continue
+            idx = touched.get(k)
+            if idx is None:
+                return None  # incomplete hint: fall back to the scan
+            g = groups.setdefault(rows, idx)
+            if g is not idx and not np.array_equal(g, idx):
+                g = np.union1d(g, idx)
+                groups[rows] = g
+        snap = None if self._sparse_store else self.store.to_numpy()
+        for k, rows in self.client.full_rows.items():
+            if k in self.derived:
+                continue
+            idx = groups[rows]
+            cur = (self.store.gather_rows(k, idx) if snap is None
+                   else snap[k][idx])
+            deltas[k] = cur - self._base[k][idx]
+        return groups, deltas
+
+    def _scan_groups(self):
+        """Fallback: full-table delta scan; wire stays sparse (only rows
+        whose delta is nonzero ship)."""
+        cur = self.store.to_numpy()
+        groups: dict[int, np.ndarray] = {}
+        diffs: dict[str, np.ndarray] = {}
+        for k, v in cur.items():
+            if k in self.derived:
+                continue
+            d = v - self._base[k]
+            nz = d != 0
+            if nz.ndim > 1:
+                nz = nz.any(axis=tuple(range(1, nz.ndim)))
+            idx = np.flatnonzero(nz)
+            diffs[k] = d
+            rows = self.client.full_rows[k]
+            g = groups.get(rows)
+            groups[rows] = idx if g is None else np.union1d(g, idx)
+        deltas = {k: diffs[k][groups[self.client.full_rows[k]]]
+                  for k in diffs}
+        return groups, deltas
 
     def sync(self) -> None:
         import time as _time
 
         t0 = _time.perf_counter()
-        cur = self.store.to_numpy()
-        # derived tables (e.g. FTRL's w) are recomputed server-side from
-        # their additive sources; shipping their deltas would be dead
-        # payload the servers discard
-        deltas = {k: cur[k] - self._base[k] for k in cur
-                  if k not in self.derived}
-        self.client.push(deltas, fixed_bytes=self.fixed_bytes)
+        got = self._touched_groups()
+        if got is None:
+            got = self._scan_groups()
+        groups, deltas = got
+        self.client.push_sparse(groups, deltas,
+                                fixed_bytes=self.fixed_bytes,
+                                compress=self.compress)
         t1 = _time.perf_counter()
-        self.pull()
+        self._apply_pull()
         if self.perf is not None:
             self.perf.add("ps_push", t1 - t0)
             self.perf.add("ps_pull", _time.perf_counter() - t1)
@@ -450,3 +745,13 @@ class SyncedStore:
             self.sync()
             return True
         return False
+
+    def wire_stats(self) -> dict:
+        """Measured wire traffic (both directions), for the distributed
+        bench's bytes-per-sync line."""
+        n = max(self.num_syncs, 1)
+        return {"num_syncs": self.num_syncs,
+                "bytes_push": self.client.bytes_push,
+                "bytes_pull": self.client.bytes_pull,
+                "bytes_per_sync": (self.client.bytes_push
+                                   + self.client.bytes_pull) / n}

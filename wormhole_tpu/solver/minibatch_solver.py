@@ -219,7 +219,8 @@ class MinibatchSolver:
             self._log(
                 f"{mode} pass {data_pass}: {n_steps} minibatches, "
                 f"avg {1e3 * t_step / n_steps:.1f}ms/step, "
-                f"{overhead:.0f}% io/comm overhead")
+                f"{overhead:.0f}% io/comm overhead, "
+                f"wall {wall:.2f}s")
         return prog
 
     # ------------------------------------------------------------- predict
