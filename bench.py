@@ -24,7 +24,9 @@ fetch/dispatch latency.
 """
 
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -226,8 +228,8 @@ def bench_linear_ps(num_buckets=1 << 26, minibatch=25000, nrows=100_000):
     import os
     import re
     import subprocess
-    import sys
     import tempfile
+    import types
 
     rng = np.random.default_rng(7)
     nnz = len(FIELD_CARDS)
@@ -257,15 +259,37 @@ print_sec = 3600
         confp = f"{td}/ps.conf"
         with open(confp, "w") as fh:
             fh.write(conf)
+        # JAX_PLATFORMS=cpu is honored by wormhole_tpu.__init__ even on
+        # images whose sitecustomize pins a TPU plugin via
+        # jax.config.update (which outranks the env var) — without that
+        # hook these "CPU" subprocesses silently run on the one-chip TPU
+        # relay and the full-table init fetch alone takes ~48s (the r3
+        # bench timeout was exactly this misrouting).
         env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
         env.pop("JAX_PLATFORM_NAME", None)
 
-        r = subprocess.run(
+        def run_group(argv, timeout):
+            """subprocess.run with whole-process-group kill on timeout:
+            run()'s own timeout kills only the direct child, leaking the
+            launcher's role processes to compete with every later bench
+            config (observed after the r3 timeout)."""
+            p = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True,
+                                 env=env, cwd=repo, start_new_session=True)
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                os.killpg(p.pid, 9)
+                p.wait()
+                raise
+            return types.SimpleNamespace(returncode=p.returncode,
+                                         stdout=out, stderr=err)
+
+        r = run_group(
             [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
              "-n", "1", "-s", "1", "--",
              sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
-            capture_output=True, text=True, timeout=1200, env=env,
-            cwd=repo)
+            timeout=600)
         assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
         m = re.search(r"\[ps-wire\] (\{.*\})", r.stdout)
         assert m, r.stdout[-2000:]
@@ -273,10 +297,9 @@ print_sec = 3600
         dist_eps = wire["last_round_nex"] / max(wire["last_round_sec"],
                                                 1e-9)
 
-        r1 = subprocess.run(
+        r1 = run_group(
             [sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
-            capture_output=True, text=True, timeout=1200, env=env,
-            cwd=repo)
+            timeout=600)
         assert r1.returncode == 0, r1.stdout[-2000:] + r1.stderr[-2000:]
         walls = re.findall(r"train pass \d+: .* wall ([0-9.]+)s",
                            r1.stdout)
@@ -384,29 +407,54 @@ def bench_gbdt(rounds=8):
     return 1.0 / sec, n / sec
 
 
+def _safe(what, fn, *args, **kw):
+    """Failure isolation: one config blowing up must never suppress the
+    lines after it — r3 lost its headline to exactly that (the PS bench
+    subprocess timeout propagated and killed the script at rc=1)."""
+    try:
+        return fn(*args, **kw)
+    except Exception:
+        print(f"[bench-error] {what} failed:", file=sys.stderr)
+        traceback.print_exc()
+        sys.stderr.flush()
+        return None
+
+
 def main():
-    eps = bench_difacto()
-    emit("difacto_fm_dim8_criteo_shape_examples_per_sec", eps,
-         "examples/sec")
-    eps = bench_kmeans()
-    emit("kmeans_k10_mnist_shape_examples_per_sec", eps, "examples/sec")
-    rps, eps = bench_gbdt()
-    emit("gbdt_depth6_higgs_shape_rounds_per_sec", rps, "rounds/sec")
-    eps = bench_linear(1 << 26, 1 << 16)
-    emit("linear_ftrl_criteo1tb_scale_64m_buckets_examples_per_sec", eps,
+    eps = _safe("difacto", bench_difacto)
+    if eps is not None:
+        emit("difacto_fm_dim8_criteo_shape_examples_per_sec", eps,
+             "examples/sec")
+    eps = _safe("kmeans", bench_kmeans)
+    if eps is not None:
+        emit("kmeans_k10_mnist_shape_examples_per_sec", eps, "examples/sec")
+    got = _safe("gbdt", bench_gbdt)
+    if got is not None:
+        emit("gbdt_depth6_higgs_shape_rounds_per_sec", got[0], "rounds/sec")
+    eps = _safe("linear_64m", bench_linear, 1 << 26, 1 << 16)
+    if eps is not None:
+        emit("linear_ftrl_criteo1tb_scale_64m_buckets_examples_per_sec",
+             eps, "examples/sec", eps / BASELINE_EXAMPLES_PER_SEC)
+    got = _safe("linear_ps", bench_linear_ps)
+    if got is not None:
+        dist_eps, single_eps, wire, dense_bytes = got
+        # vs_baseline here = ratio to the single-process run on the same
+        # data/platform (>= ~0.77 means within the 1.3x PS-overhead
+        # target)
+        emit("linear_ftrl_ps_dist_64m_buckets_examples_per_sec", dist_eps,
+             "examples/sec", dist_eps / single_eps)
+        # vs_baseline = fraction of what a dense-table sync would move
+        emit("ps_wire_bytes_per_sync_64m_buckets", wire["bytes_per_sync"],
+             "bytes", wire["bytes_per_sync"] / dense_bytes)
+    # headline LAST: the driver parses the final JSON line. A headline
+    # failure must stay LOUD (rc=1) — otherwise the previous line (a
+    # different metric in different units) would silently be recorded
+    # as the headline.
+    eps = _safe("headline", bench_linear, NUM_BUCKETS, MINIBATCH)
+    if eps is None:
+        sys.exit(1)
+    emit("linear_ftrl_criteo_shape_examples_per_sec", eps,
          "examples/sec", eps / BASELINE_EXAMPLES_PER_SEC)
-    dist_eps, single_eps, wire, dense_bytes = bench_linear_ps()
-    # vs_baseline here = ratio to the single-process run on the same
-    # data/platform (>= ~0.77 means within the 1.3x PS-overhead target)
-    emit("linear_ftrl_ps_dist_64m_buckets_examples_per_sec", dist_eps,
-         "examples/sec", dist_eps / single_eps)
-    # vs_baseline = fraction of what a dense-table sync would move
-    emit("ps_wire_bytes_per_sync_64m_buckets", wire["bytes_per_sync"],
-         "bytes", wire["bytes_per_sync"] / dense_bytes)
-    # headline LAST: the driver parses the final JSON line
-    eps = bench_linear(NUM_BUCKETS, MINIBATCH)
-    emit("linear_ftrl_criteo_shape_examples_per_sec", eps, "examples/sec",
-         eps / BASELINE_EXAMPLES_PER_SEC)
 
 
 if __name__ == "__main__":

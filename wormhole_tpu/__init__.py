@@ -20,4 +20,49 @@ See SURVEY.md for the reference structural analysis this build follows.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+
+def _honor_jax_platforms_env() -> None:
+    """Make the JAX_PLATFORMS env var effective even on images whose
+    sitecustomize registers a TPU plugin AND calls
+    jax.config.update("jax_platforms", ...) at interpreter startup — the
+    explicit config value silently outranks the env var, so a launcher
+    subprocess spawned with JAX_PLATFORMS=cpu would still initialize the
+    TPU backend (and on this image funnel every device transfer through
+    the one-chip relay). Apps import wormhole_tpu before touching any
+    backend, so re-aligning the config here is safe and cheap."""
+    want = _os.environ.get("JAX_PLATFORMS")
+    if not want or "axon" in want:
+        return  # default TPU path: leave the plugin's selection alone
+    try:
+        import jax
+
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # no jax / backends already initialized: nothing to fix
+
+
+_honor_jax_platforms_env()
+
+
+def _install_stackdump() -> None:
+    """WORMHOLE_STACKDUMP=1: dump all-thread Python stacks to stderr on
+    SIGUSR1 — the only way to see where a launcher-spawned role process
+    is stuck on boxes without gdb/py-spy (used to diagnose the r3 PS
+    bench stall)."""
+    if _os.environ.get("WORMHOLE_STACKDUMP") != "1":
+        return
+    try:
+        import faulthandler
+        import signal
+
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (ImportError, AttributeError, ValueError):
+        pass  # non-main thread / platform without SIGUSR1
+
+
+_install_stackdump()
+
 from wormhole_tpu.data.rowblock import RowBlock, DeviceBatch  # noqa: F401

