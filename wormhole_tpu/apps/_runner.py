@@ -125,10 +125,6 @@ def _run_worker_global(cfg, env, make_learner, verbose: bool) -> dict:
     ranks drained."""
     from wormhole_tpu.parallel import multihost as mh
 
-    if getattr(cfg, "predict_out", None):
-        raise NotImplementedError(
-            "predict_out is not supported in global_mesh mode yet; run "
-            "predict single-process on the saved model")
     with mh.worker_session(env) as client:
         return _global_train(cfg, env, make_learner, verbose, client)
 
@@ -244,16 +240,122 @@ def _global_train(cfg, env, make_learner, verbose, client) -> dict:
         ckpt.save_model(_GlobalView, cfg.model_out)
         if verbose:
             print(f"model saved: {cfg.model_out}", flush=True)
+    if getattr(cfg, "predict_out", None):
+        _global_predict(cfg, env, learner, global_args, empty, verbose)
     return result
 
 
+def _global_predict(cfg, env, learner, global_args, empty, verbose) -> None:
+    """Lockstep SPMD predict (PredictStream parity, iter_solver.h:140-156
+    + the reference's per-part output files): each rank streams ITS
+    stable part slice through the shared jitted forward — every step is
+    a collective, so drained ranks keep feeding masked-empty batches
+    until the GLOBAL live-row count hits zero — and writes margins for
+    its contributed rows to `{predict_out}_rank-R_part-J` (same naming
+    as the PS-mode per-rank predict)."""
+    import os
+
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.parallel import multihost as mh
+
+    rank = env.rank
+    local_rows = cfg.minibatch // env.num_workers
+    pred_fn = learner.global_predict_protocol()
+    data = cfg.val_data or cfg.train_data
+    parts = mh.rank_parts(data, cfg.num_parts_per_file, env)
+    os.makedirs(os.path.dirname(cfg.predict_out) or ".", exist_ok=True)
+    prob = bool(getattr(cfg, "prob_predict", False))
+
+    def path(j):
+        return f"{cfg.predict_out}_rank-{rank}_part-{j}"
+
+    for j in range(len(parts)):  # zero-row parts still get their file
+        open(path(j), "w").close()
+
+    def blocks():
+        for j, (f, k) in enumerate(parts):
+            for blk in MinibatchIter(f, k, cfg.num_parts_per_file,
+                                     cfg.data_format,
+                                     minibatch_size=local_rows):
+                yield j, blk
+
+    it = blocks()
+    while True:
+        got = next(it, None)
+        blk = got[1] if got is not None else empty
+        size = blk.size
+        seg, idx, val, _, mask = global_args(blk)
+        margins, nex = pred_fn((seg, idx, val, mask))
+        if float(nex) == 0.0:
+            break  # every rank drained (collective fact)
+        if got is None or size == 0:
+            continue
+        local = mh.fetch_local_rows(margins, rank * local_rows,
+                                    rank * local_rows + size)
+        if prob:
+            import numpy as _np
+
+            local = 1.0 / (1.0 + _np.exp(-local))
+        with open(path(got[0]), "a") as fh:
+            for m in local:
+                fh.write(f"{m:.6g}\n")
+    if verbose and rank == 0:
+        print(f"predict written: {cfg.predict_out}_rank-*", flush=True)
+
+
+def _wait_server_group(sched: Scheduler, timeout: float = 60.0) -> PSClient:
+    """Block until every `-s` server registered its URI; returns a client
+    over the group (the scheduler's command channel for load/save)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        with sched._lock:
+            if len(sched._server_uris) >= sched.num_servers:
+                break
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                "ps servers did not all register within "
+                f"{timeout:.0f}s ({len(sched._server_uris)}"
+                f"/{sched.num_servers})")
+        time.sleep(0.2)
+    return PSClient(_server_uris(sched))
+
+
+_MODEL_LOADED_KEY = "__ps_model_loaded__"
+
+
 def _run_scheduler(cfg, env, verbose: bool) -> dict:
+    """Scheduler loop with the reference's iteration protocol
+    (minibatch_solver.h:96-133): command the server group to LOAD
+    model_in before any worker initializes (resuming pass numbering at
+    load_iter+1), SAVE `_iter-K` checkpoints every save_iter passes, and
+    save the final model at job end."""
     sched = Scheduler.from_env(env)
     sched.serve()
     t0 = time.time()
     result = {}
+    ps = None
+    start_pass = 0
     try:
-        for dp in range(cfg.max_data_pass):
+        if cfg.model_in and cfg.load_iter >= 0:
+            # resume pass numbering in EVERY mode (PS servers load below;
+            # replica-mode workers load model_in themselves) — the
+            # already-trained passes must not be re-dispatched
+            start_pass = cfg.load_iter + 1
+        if env.num_servers > 0:
+            ps = _wait_server_group(sched)
+            if cfg.model_in:
+                it = cfg.load_iter if cfg.load_iter >= 0 else None
+                ps.load(cfg.model_in, it)
+                if verbose:
+                    print(f"model loaded from {cfg.model_in}"
+                          + (f" iter {cfg.load_iter}"
+                             if cfg.load_iter >= 0 else " (last)"),
+                          flush=True)
+                # release the workers gated on the load (they must not
+                # create fresh tables while servers are still loading)
+                with sched._lock:
+                    sched._blobs[_MODEL_LOADED_KEY] = "1"
+        for dp in range(start_pass, cfg.max_data_pass):
             n = sched.start_round(cfg.train_data, cfg.num_parts_per_file,
                                   cfg.data_format, WorkType.TRAIN, dp,
                                   local_data=getattr(cfg, "local_data",
@@ -269,6 +371,16 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
                 if verbose:
                     print(f"validation pass {dp}", flush=True)
                 result["val"] = sched.wait_round(cfg.print_sec, t0, verbose)
+            if (ps is not None and cfg.model_out
+                    and getattr(cfg, "save_iter", 0) > 0
+                    and (dp + 1) % cfg.save_iter == 0
+                    and dp + 1 < cfg.max_data_pass):
+                # periodic `_iter-K` snapshot of the server shards — the
+                # mid-job recovery point (minibatch_solver.h:124-127)
+                paths = ps.save(cfg.model_out, it=dp)
+                if verbose:
+                    print(f"model saved for iter {dp}: {paths}",
+                          flush=True)
         if "val" in result:
             # machine-readable final metrics line (the tutorial log's final
             # row, criteo_kaggle.rst:78)
@@ -278,8 +390,7 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
                   flush=True)
         # command the server group to save its shards, then release
         # everyone (IterScheduler::SaveModel -> kServerGroup parity)
-        if env.num_servers > 0:
-            ps = PSClient([u for u in _server_uris(sched)])
+        if ps is not None:
             if cfg.model_out:
                 paths = ps.save(cfg.model_out)
                 if verbose:
@@ -315,11 +426,28 @@ def _run_server(cfg, env) -> dict:
 
 
 def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
+    from wormhole_tpu.runtime.tracker import LivenessPinger
+
     learner = make_learner(cfg, env)
     client = SchedulerClient(env.scheduler_uri, f"worker-{env.rank}")
     client.register()
+    # background liveness pings: a worker streaming a large part (or in
+    # its first jit compile) makes no scheduler RPC for minutes; without
+    # pings the liveness sweep would evict it and — with the
+    # all-workers-lost abort — kill a healthy single-worker job
+    pinger = LivenessPinger(client)
+    try:
+        return _run_worker_body(cfg, env, verbose, learner, client)
+    finally:
+        pinger.stop()
+
+
+def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
     pool = RemotePool(client)
-    if cfg.model_in:
+    if cfg.model_in and env.num_servers == 0:
+        # replica mode only: with a server group the SCHEDULER commands
+        # the servers to load (the model never crosses the worker wire);
+        # this worker just gates on that load and pulls the stamped rows
         ckpt.load_model(_store(learner), cfg.model_in,
                         cfg.load_iter if cfg.load_iter >= 0 else None)
     synced = None
@@ -332,6 +460,17 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
                     "servers registered within 60s — a server process "
                     "likely died at startup")
             time.sleep(0.2)
+        if cfg.model_in:
+            # wait for the scheduler's load command to finish — an
+            # init_spec racing ahead of it would create FRESH tables and
+            # the load would then (correctly) refuse to clobber them
+            load_deadline = time.monotonic() + 120.0
+            while not client.call(op="blob_get",
+                                  key=_MODEL_LOADED_KEY)["ok"]:
+                if time.monotonic() >= load_deadline:
+                    raise RuntimeError(
+                        "scheduler never announced the model_in load")
+                time.sleep(0.2)
         ps = PSClient(s["uris"])
         learner.track_touched = hasattr(learner, "collect_touched")
         synced = SyncedStore(
@@ -340,11 +479,7 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
             fixed_bytes=getattr(cfg, "fixed_bytes", 0),
             derived=getattr(learner, "derived_tables", dict)(),
             touched_fn=getattr(learner, "collect_touched", None),
-            compress=bool(getattr(cfg, "msg_compression", 0)),
-            # warm start: the loaded model is this worker's init state,
-            # so it must be OFFERED (array path), not spec-created as
-            # zeros
-            offer_arrays=bool(cfg.model_in))
+            compress=bool(getattr(cfg, "msg_compression", 0)))
         synced.init()
     solver = MinibatchSolver(learner, cfg, verbose=False)
     if synced is not None:

@@ -590,6 +590,31 @@ class DifactoLearner:
 
         return train_fn, eval_fn
 
+    def global_predict_protocol(self):
+        """pred_fn over (seg, idx, val, mask) GLOBAL arrays — see
+        LinearLearner.global_predict_protocol."""
+        import jax.numpy as jnp
+
+        from wormhole_tpu.parallel.mesh import batch_sharding
+
+        vb = self.cfg.vb
+        bsh = batch_sharding(self.mesh, 1)
+
+        @jax.jit
+        def pred(state, vstate, seg, idx, val, mask):
+            vidx = idx % np.int32(vb)
+            margin, _ = self._fwd(state, vstate, seg, idx, vidx, val,
+                                  jnp.zeros_like(mask), mask)
+            return (jax.lax.with_sharding_constraint(margin, bsh),
+                    jnp.sum(mask))
+
+        def pred_fn(args):
+            seg, idx, val, mask = args
+            return pred(self.store.state, self.vstore.state,
+                        seg, idx, val, mask)
+
+        return pred_fn
+
     def _prepared(self, blk, train: bool):
         if isinstance(blk, RowBlock):
             return self.prepare_batch(blk, train=train)

@@ -390,6 +390,26 @@ class LinearLearner:
 
         return train_fn, eval_fn
 
+    def global_predict_protocol(self):
+        """pred_fn over (seg, idx, val, mask) GLOBAL arrays returning
+        (margins pinned to the batch sharding — so each rank reads back
+        exactly its contributed rows — and the GLOBAL live-row count
+        that drives the lockstep drain decision)."""
+        from wormhole_tpu.parallel.mesh import batch_sharding
+
+        bsh = batch_sharding(self.mesh, 1)
+
+        @jax.jit
+        def pred(state, seg, idx, val, mask):
+            xw = self._predict_step(state, seg, idx, val)
+            return jax.lax.with_sharding_constraint(xw, bsh), jnp.sum(mask)
+
+        def pred_fn(args):
+            seg, idx, val, mask = args
+            return pred(self.store.state, seg, idx, val, mask)
+
+        return pred_fn
+
     def derived_tables(self) -> dict:
         """Tables that are non-additive pure functions of additive ones,
         for server-side recomputation in the multi-process PS data plane

@@ -85,6 +85,30 @@ def fetch_replicated(arr) -> np.ndarray:
     return np.asarray(arr.addressable_data(0))
 
 
+def fetch_local_rows(arr, lo: int, hi: int) -> np.ndarray:
+    """Host copy of rows [lo, hi) of a batch-sharded global array,
+    assembled from this process's addressable shards only — the range a
+    rank contributed via global_batch is exactly the range its own
+    devices hold, so no cross-host transfer happens (global-mesh predict
+    reads back its margins this way)."""
+    out = np.empty((hi - lo, *arr.shape[1:]), np.float32)
+    filled = np.zeros(hi - lo, bool)
+    for s in arr.addressable_shards:
+        sl = s.index[0] if s.index else slice(None)
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else arr.shape[0]
+        a, b = max(start, lo), min(stop, hi)
+        if a >= b:
+            continue
+        data = np.asarray(s.data)
+        out[a - lo:b - lo] = data[a - start:b - start]
+        filled[a - lo:b - lo] = True
+    assert filled.all(), (
+        f"rows [{lo}, {hi}) not fully addressable on this process — "
+        "the output sharding does not match the rank's contribution")
+    return out
+
+
 def exit_barrier(client=None, world: int = 0,
                  timeout: float = 120.0) -> None:
     """Rendezvous before process exit: the coordination-service leader

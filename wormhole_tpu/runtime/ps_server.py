@@ -340,6 +340,11 @@ class ServerNode:
                         return {"error":
                                 f"init spec mismatch: offered {want} vs "
                                 f"created {have}"}, {}
+                    if not self.derived:
+                        # checkpoint loads don't carry derived-table
+                        # specs; adopt them from the first worker
+                        self.derived = header.get("derived") or {}
+                    self._stamp_nonspec_groups(header["specs"])
                 now = _time.monotonic()
                 claims = getattr(self, "_claims", {})
                 pending = getattr(self, "_pending", set())
@@ -444,6 +449,26 @@ class ServerNode:
         if op == "save":
             path = self._save(header["base"], header.get("iter"))
             return {"ok": True, "path": path}, {}
+        if op == "load":
+            # IterScheduler::LoadModel parity (iter_solver.h:40-47): the
+            # scheduler commands the server group to load a checkpoint;
+            # each server takes its bucket-range slice straight from the
+            # filesystem — the model never crosses the worker wire.
+            with self._lock:
+                if self.tables:
+                    return {"error": "load into a non-empty server "
+                                     "(command load before workers init)"
+                            }, {}
+                try:
+                    self._load(header["base"], header.get("iter"))
+                except Exception as e:
+                    # an error REPLY, not an escaped exception: a typo'd
+                    # model_in must surface as "no such checkpoint" at
+                    # the scheduler, not as a dead-connection mystery at
+                    # the workers
+                    self.tables.clear()
+                    return {"error": f"checkpoint load failed: {e}"}, {}
+                return {"ok": True, "clock": self.clock}, {}
         if op == "stats":
             with self._lock:
                 return {"ok": True, "num_push": self.num_push,
@@ -484,6 +509,88 @@ class ServerNode:
         for g in self._dirty:
             self._dirty[g] = []
 
+    def _load(self, base: str, it: Optional[int]) -> None:
+        """Create this shard's tables from a checkpoint (caller holds the
+        lock). When the checkpoint was written by a same-world server
+        group, this server reads ONLY its own `_part-<rank>` file (the
+        __full_rows__ tag each part carries says the full table sizes);
+        on any shard-count mismatch it falls back to concatenating all
+        parts and slicing its range. Every loaded row that differs from
+        the zero init is version-stamped, so a worker that initializes to
+        zeros and pulls since=0 receives exactly the model's nonzero
+        rows — O(model nnz) wire, not O(table). Rows of NON-zero-init
+        tables (e.g. difacto's seeded V) can differ from the load even
+        where the load is zero; init_spec stamps those groups fully when
+        a worker's spec names them (see _stamp_nonspec_groups)."""
+        import glob
+        import json as _json
+
+        from wormhole_tpu.utils.checkpoint import (load_parts, part_name,
+                                                   save_prefix)
+
+        own = part_name(base, it if (it is not None and it >= 0) else None,
+                        self.rank) + ".npz"
+        prefix = save_prefix(base, it if (it is not None and it >= 0)
+                             else None)
+        npeers = len(glob.glob(prefix + "_part-*.npz"))
+        shard_arrays = None
+        if npeers == self.world and os.path.exists(own):
+            got = dict(np.load(own))
+            meta = got.pop("__full_rows__", None)
+            if meta is not None:
+                self.full_rows = {
+                    k: int(n) for k, n in
+                    _json.loads(bytes(meta.tobytes()).decode()).items()}
+                shard_arrays = got
+        if shard_arrays is None:
+            arrays = load_parts(base, it)
+            self.full_rows = {k: int(v.shape[0])
+                              for k, v in arrays.items()}
+            shard_arrays = {}
+            for k, v in arrays.items():
+                lo, hi = shard_range(v.shape[0], self.rank, self.world)
+                shard_arrays[k] = np.ascontiguousarray(v[lo:hi],
+                                                       np.float32)
+        self._full_shapes = {
+            k: [self.full_rows[k], *v.shape[1:]]
+            for k, v in shard_arrays.items()}
+        self._pending = set()
+        self._claims = {}
+        self._loaded = True
+        self._stamped_all: set[int] = set()
+        for k, v in shard_arrays.items():
+            self.tables[k] = np.ascontiguousarray(v, np.float32)
+        self._create_group_meta()
+        self.clock = 1
+        for g, ver in self._ver.items():
+            nz = None
+            for k, rows in self.full_rows.items():
+                if rows != g:
+                    continue
+                t_nz = self.tables[k] != 0
+                if t_nz.ndim > 1:
+                    t_nz = t_nz.any(axis=tuple(range(1, t_nz.ndim)))
+                nz = t_nz if nz is None else (nz | t_nz)
+            if nz is not None:
+                ver[nz] = self.clock
+
+    def _stamp_nonspec_groups(self, specs: dict) -> None:
+        """After a checkpoint load, groups holding non-zero-init tables
+        must be stamped wholly dirty the first time a worker's init spec
+        names them: the worker's seeded init differs from the loaded
+        values even at loaded-zero rows, so only a full-group pull makes
+        its base mirror coherent (caller holds the lock)."""
+        if not getattr(self, "_loaded", False):
+            return
+        for k, s in specs.items():
+            if s.get("zero", True) or k in self.derived:
+                continue
+            g = self.full_rows.get(k)
+            if g is None or g in self._stamped_all:
+                continue
+            self._ver[g][:] = self.clock
+            self._stamped_all.add(g)
+
     def _save(self, base: str, it: Optional[int]) -> str:
         import glob
         import re
@@ -511,6 +618,12 @@ class ServerNode:
             path = prefix + ".npz"
         else:
             path = part_name(base, it, self.rank) + ".npz"
+        # __full_rows__ tag: lets a same-world server reload ONLY its own
+        # part (ServerNode._load fast path); load_parts skips "__" keys
+        import json as _json
+
+        tables["__full_rows__"] = np.frombuffer(
+            _json.dumps(self.full_rows).encode(), np.uint8).copy()
         atomic_savez(path, compressed=True, **tables)
         return path
 
@@ -547,15 +660,24 @@ class PSClient:
         f = self._file(r)
         if compress:
             header = dict(header, comp_reply=1)
+        op_name = header.get("op", "?")
         try:
             sent = send_frame(f, header, arrays, fixed_bytes, compress)
             got = recv_frame(f)
-        except OSError:
+        except OSError as e:
             self.close(r)
-            raise
+            raise ConnectionError(
+                f"ps server {self.uris[r]} unreachable during "
+                f"'{op_name}' ({e}) — the server process likely died; "
+                "the job must be restarted (resume from the last "
+                "_iter-K checkpoint)") from e
         if got is None:
             self.close(r)
-            raise ConnectionResetError(f"server {self.uris[r]} closed")
+            raise ConnectionResetError(
+                f"ps server {self.uris[r]} closed the connection during "
+                f"'{op_name}' — the server process likely died; the job "
+                "must be restarted (resume from the last _iter-K "
+                "checkpoint)")
         h, arrs, received = got
         if "error" in h:
             raise RuntimeError(f"ps server error: {h['error']}")
@@ -711,6 +833,13 @@ class PSClient:
     def save(self, base: str, it: Optional[int] = None) -> list[str]:
         return [self._rpc(r, {"op": "save", "base": base, "iter": it})[0]
                 ["path"] for r in range(self.world)]
+
+    def load(self, base: str, it: Optional[int] = None) -> None:
+        """Command every server to load its shard of a checkpoint
+        (IterScheduler::LoadModel parity) — must run before any worker
+        init so the loaded state IS the table-creation state."""
+        for r in range(self.world):
+            self._rpc(r, {"op": "load", "base": base, "iter": it})
 
     def stats(self, r: int = 0) -> dict:
         return self._rpc(r, {"op": "stats"})[0]

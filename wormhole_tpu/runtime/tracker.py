@@ -112,6 +112,8 @@ class Scheduler:
         self._barrier_gen: dict[str, int] = {}   # name -> generation
         self._epoch = 0                          # bumped per dispatch round
         self._shutdown = False                   # job end; workers exit
+        self._seen_worker = False                # any worker ever registered
+        self._blobs: dict[str, str] = {}         # rendezvous KV payloads
         self._done = False
         self._srv = _Server((host, port), _Handler)
         self._srv.scheduler = self  # type: ignore
@@ -222,8 +224,30 @@ class Scheduler:
         if verbose:
             print(Progress.header(), flush=True)
         next_print = time.time() + print_sec
+        none_live_since: Optional[float] = None
         while not self._round_finished():
             time.sleep(min(0.2, print_sec))
+            with self._lock:
+                live = [n for n in self._nodes if n.startswith("worker")]
+            if self._seen_worker and not live:
+                # every worker gone from the liveness table. Workers run
+                # a LivenessPinger, so eviction means real death — but
+                # grant one extra node_timeout of grace before aborting
+                # so a transient stall (GC pause, ping thread descheduled)
+                # can never kill a healthy job. After that, abort with a
+                # clear error instead of waiting forever for parts nobody
+                # will finish; the job is resumable from the last
+                # save_iter snapshot.
+                now = time.monotonic()
+                if none_live_since is None:
+                    none_live_since = now
+                elif now - none_live_since > self.node_timeout:
+                    raise RuntimeError(
+                        "all workers lost mid-round; aborting the job "
+                        "(resume from the last _iter-K checkpoint with "
+                        "model_in/load_iter)")
+            else:
+                none_live_since = None
             if verbose and time.time() >= next_print:
                 print(self.progress.row(t0), flush=True)
                 next_print = time.time() + print_sec
@@ -244,6 +268,8 @@ class Scheduler:
         node = req.get("node", "?")
         with self._lock:
             self._nodes[node] = time.monotonic()
+            if node.startswith("worker"):
+                self._seen_worker = True
         if op == "register":
             return {"ok": True, "epoch": self._epoch}
         if op == "register_server":
@@ -312,19 +338,17 @@ class Scheduler:
             # tiny rendezvous KV (host-side rabit::Broadcast payloads,
             # e.g. the k-means centroid init from rank 0)
             with self._lock:
-                if not hasattr(self, "_blobs"):
-                    self._blobs = {}
                 self._blobs[req["key"]] = req["data"]
             return {"ok": True}
         if op == "blob_del":
             # consumed rendezvous payloads should not sit in scheduler
             # memory for the job's lifetime
             with self._lock:
-                getattr(self, "_blobs", {}).pop(req["key"], None)
+                self._blobs.pop(req["key"], None)
             return {"ok": True}
         if op == "blob_get":
             with self._lock:
-                data = getattr(self, "_blobs", {}).get(req["key"])
+                data = self._blobs.get(req["key"])
             return {"ok": data is not None, "data": data}
         if op == "bye":
             # explicit deregistration (global-mesh workers) so liveness

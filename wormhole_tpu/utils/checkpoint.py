@@ -120,9 +120,10 @@ def load_parts(base: str, it: Optional[int] = None) -> dict[str, np.ndarray]:
                 f"no checkpoint matches {prefix}.npz or {prefix}_part-*")
         parts = [load_uri(p) for p in paths]
         return {k: np.concatenate([p[k] for p in parts], axis=0)
-                for k in parts[0]}
+                for k in parts[0] if not k.startswith("__")}
     if os.path.exists(prefix + ".npz"):
-        return dict(np.load(prefix + ".npz"))
+        return {k: v for k, v in np.load(prefix + ".npz").items()
+                if not k.startswith("__")}
     paths = sorted(
         glob.glob(prefix + "_part-*.npz"),
         key=lambda p: int(re.search(r"_part-(\d+)\.npz$", p).group(1)),
@@ -131,8 +132,11 @@ def load_parts(base: str, it: Optional[int] = None) -> dict[str, np.ndarray]:
         raise FileNotFoundError(
             f"no checkpoint matches {prefix}.npz or {prefix}_part-*")
     parts = [dict(np.load(p)) for p in paths]
+    # "__"-prefixed keys are per-part metadata (e.g. the server's
+    # __full_rows__ tag), not model tables
     return {
-        k: np.concatenate([p[k] for p in parts], axis=0) for k in parts[0]
+        k: np.concatenate([p[k] for p in parts], axis=0)
+        for k in parts[0] if not k.startswith("__")
     }
 
 
