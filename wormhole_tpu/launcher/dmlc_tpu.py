@@ -1,9 +1,10 @@
 """dmlc_tpu: launch a distributed wormhole-tpu job.
 
 Parity with the reference trackers (dmlc-core tracker/dmlc_local.py,
-dmlc_mpi.py, dmlc_yarn.py — reference doc/common/build.rst:53-123): spawn
-1 scheduler + N worker processes of the same program, wiring the role /
-rank / rendezvous env vars the program reads via `runtime.node_env()`.
+dmlc_ssh-style multi-host, dmlc_mpi.py, dmlc_yarn.py — reference
+doc/common/build.rst:53-123): spawn 1 scheduler + N worker processes of
+the same program, wiring the role / rank / rendezvous env vars the
+program reads via `runtime.node_env()`.
 
 Mapping the reference's launch dimensions onto TPU:
 - `-n` workers = host processes, one per TPU host in a pod slice (or N
@@ -15,19 +16,26 @@ Mapping the reference's launch dimensions onto TPU:
   pull merged state through them with bounded staleness, so all workers
   train ONE model (async_sgd.h:240-288 parity). Within each worker the
   device mesh additionally shards tables over its local devices.
-- multi-host pods: each worker also gets a rank so apps can call
-  jax.distributed.initialize and form the global device mesh over
-  ICI/DCN; the control plane here stays the same.
+- multi-host pods: `--hosts a,b,c` runs the scheduler locally and
+  spawns the role processes across the hosts round-robin through
+  `--ssh-cmd` (plain ssh by default; point it at a gcloud wrapper for
+  TPU pods — docs/distributed.md has the recipe). Each worker also gets
+  a rank so apps can call jax.distributed.initialize and form the
+  global device mesh over ICI/DCN; the control plane stays the same.
 
 Usage:
   python -m wormhole_tpu.launcher.dmlc_tpu -n 4 -s 2 -- \
       python -m wormhole_tpu.apps.linear learn/linear/demo.conf
+  python -m wormhole_tpu.launcher.dmlc_tpu -n 4 -s 2 \
+      --hosts tpu-vm-0,tpu-vm-1,tpu-vm-2,tpu-vm-3 -- \
+      python -m wormhole_tpu.apps.linear gs_demo.conf
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -41,6 +49,18 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _default_host_ip() -> str:
+    """A launch-host address remote role processes can dial back to (the
+    dmlc ssh tracker's socket.getsockname trick: no traffic is sent; the
+    OS just picks the outbound interface)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
 def _stream(prefix: str, pipe, out):
     for line in iter(pipe.readline, b""):
         out.write(f"[{prefix}] ".encode() + line)
@@ -49,20 +69,41 @@ def _stream(prefix: str, pipe, out):
 
 def launch(num_workers: int, num_servers: int, cmd: list[str],
            node_timeout: float = 30.0,
-           env_extra: dict | None = None) -> int:
+           env_extra: dict | None = None,
+           hosts: list[str] | None = None,
+           ssh_cmd: str = "ssh",
+           remote_cwd: str | None = None,
+           scheduler_host: str | None = None,
+           coord_port: int = 0,
+           pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
+                                        "PYTHONPATH")) -> int:
     """Spawn the scheduler + N workers of `cmd`; stream their output with
     role prefixes; return the first nonzero exit code (0 if all clean).
     On scheduler exit, surviving workers are terminated (the reference
-    tracker's process-group teardown)."""
-    port = _free_port()
-    uri = f"127.0.0.1:{port}"
-    # jax.distributed rendezvous for apps that opt into the global-mesh
-    # mode (parallel/multihost.py); worker 0 binds it on first use
-    coord_uri = f"127.0.0.1:{_free_port()}"
+    tracker's process-group teardown).
 
-    def spawn(role: str, rank: int) -> subprocess.Popen:
-        env = dict(os.environ)
-        env.update(
+    With `hosts`, the scheduler runs locally and the server/worker
+    processes are spawned round-robin across the hosts via `ssh_cmd`
+    (the dmlc ssh-tracker model): each remote invocation is
+    `<ssh_cmd> <host> 'cd <remote_cwd> && env <contract> <cmd>'` — the
+    same WH_* env contract either way, with the scheduler URI bound on a
+    launch-host address the remote nodes can dial. The jax.distributed
+    coordinator lands on hosts[0] (worker 0's host) at `coord_port`."""
+    multi = bool(hosts)
+    sched_host = (scheduler_host or _default_host_ip()) if multi \
+        else "127.0.0.1"
+    uri = f"{sched_host}:{_free_port()}"
+    # jax.distributed rendezvous for apps that opt into the global-mesh
+    # mode (parallel/multihost.py); worker 0 binds it on first use. On a
+    # pod, worker 0 lives on hosts[0]; coord_port must be free THERE, so
+    # it is explicit (the launcher can only probe local ports).
+    if multi:
+        coord_uri = f"{hosts[0]}:{coord_port or 29477}"
+    else:
+        coord_uri = f"127.0.0.1:{_free_port()}"
+
+    def contract(role: str, rank: int) -> dict:
+        env = dict(
             WH_ROLE=role,
             WH_RANK=str(rank),
             WH_NUM_WORKERS=str(num_workers),
@@ -73,12 +114,36 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
         )
         if env_extra:
             env.update({k: str(v) for k, v in env_extra.items()})
+        return env
+
+    def spawn(role: str, rank: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(contract(role, rank))
         return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT)
 
-    sched = spawn("scheduler", 0)
-    servers = [spawn("server", r) for r in range(num_servers)]
-    workers = [spawn("worker", r) for r in range(num_workers)]
+    def spawn_remote(role: str, rank: int) -> subprocess.Popen:
+        # workers spread over hosts by rank; servers continue the
+        # round-robin after them so a host gets at most
+        # ceil((n+s)/len(hosts)) processes
+        slot = rank if role == "worker" else num_workers + rank
+        host = hosts[slot % len(hosts)]
+        kv = dict(contract(role, rank))
+        for k in pass_env:
+            if k in os.environ and k not in kv:
+                kv[k] = os.environ[k]
+        line = "cd " + shlex.quote(remote_cwd or os.getcwd())
+        line += " && env " + " ".join(
+            shlex.quote(f"{k}={v}") for k, v in kv.items())
+        line += " " + " ".join(shlex.quote(c) for c in cmd)
+        argv = shlex.split(ssh_cmd) + [host, line]
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
+    role_spawn = spawn_remote if multi else spawn
+    sched = spawn("scheduler", 0)  # the tracker node always runs locally
+    servers = [role_spawn("server", r) for r in range(num_servers)]
+    workers = [role_spawn("worker", r) for r in range(num_workers)]
     procs = {"scheduler": sched}
     procs.update({f"server-{r}": p for r, p in enumerate(servers)})
     procs.update({f"worker-{r}": p for r, p in enumerate(workers)})
@@ -118,6 +183,29 @@ def main(argv=None) -> int:
     ap.add_argument("-s", "--num-servers", type=int, default=1,
                     help="parameter-server processes (0 = replica mode)")
     ap.add_argument("--node-timeout", type=float, default=30.0)
+    ap.add_argument("-H", "--hosts", default=None,
+                    help="comma-separated hosts to spawn role processes "
+                         "on via --ssh-cmd (scheduler stays local); "
+                         "omit for an all-local launch")
+    ap.add_argument("--hostfile", default=None,
+                    help="file with one host per line (dmlc ssh-tracker "
+                         "convention); merged with --hosts")
+    ap.add_argument("--ssh-cmd", default="ssh",
+                    help="remote shell command; invoked as "
+                         "`<ssh-cmd> <host> '<remote command line>'` "
+                         "(e.g. 'ssh -o StrictHostKeyChecking=no', or a "
+                         "gcloud tpu-vm wrapper script)")
+    ap.add_argument("--remote-cwd", default=None,
+                    help="working directory on the hosts (default: the "
+                         "launch host's cwd — fine for shared "
+                         "filesystems / identical pod VM images)")
+    ap.add_argument("--scheduler-host", default=None,
+                    help="launch-host address the remote nodes dial for "
+                         "the control plane (default: auto-detected "
+                         "outbound interface)")
+    ap.add_argument("--coord-port", type=int, default=0,
+                    help="jax.distributed coordinator port on the first "
+                         "host (global-mesh mode on pods)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="program to launch (prefix with --)")
     args = ap.parse_args(argv)
@@ -126,8 +214,17 @@ def main(argv=None) -> int:
         cmd = cmd[1:]
     if not cmd:
         ap.error("no command given")
+    hosts = [h.strip() for h in (args.hosts or "").split(",") if h.strip()]
+    if args.hostfile:
+        with open(args.hostfile) as fh:
+            hosts += [ln.strip() for ln in fh if ln.strip()
+                      and not ln.startswith("#")]
     return launch(args.num_workers, args.num_servers, cmd,
-                  node_timeout=args.node_timeout)
+                  node_timeout=args.node_timeout,
+                  hosts=hosts or None, ssh_cmd=args.ssh_cmd,
+                  remote_cwd=args.remote_cwd,
+                  scheduler_host=args.scheduler_host,
+                  coord_port=args.coord_port)
 
 
 if __name__ == "__main__":
