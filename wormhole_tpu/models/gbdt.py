@@ -317,6 +317,15 @@ class GbdtLearner:
         lam, gam, mcw, eta = (cfg.reg_lambda, cfg.gamma,
                               cfg.min_child_weight, cfg.eta)
         mesh = self.mesh
+        # sibling subtraction (xgboost's classic halving): levels past
+        # the root accumulate only the LEFT child of every split pair —
+        # half the one-hot-matmul M axis — and derive the right child as
+        # parent − left. Rows of a NON-splitting parent are active in
+        # neither child, so its "right child" slot derives to the
+        # parent's own histogram — garbage, but unreachable: routing
+        # only ever descends into children of split nodes.
+        sibling = num_nodes > 1
+        hist_nodes = num_nodes // 2 if sibling else num_nodes
 
         use_mxu_hist = cfg.hist_kernel == "mxu" or (
             cfg.hist_kernel == "auto" and jax.default_backend() == "tpu")
@@ -329,23 +338,23 @@ class GbdtLearner:
                 # scatter costs ~10ns per rows x F element on TPU
                 from wormhole_tpu.ops.hist import level_hist
 
-                G, H = level_hist(binned, g, h, rel, num_nodes, B)
+                G, H = level_hist(binned, g, h, rel, hist_nodes, B)
             else:
                 n = g.shape[0]
                 base = (rel[:, None] * (F * B)
                         + jnp.arange(F, dtype=jnp.int32)[None, :] * B)
                 idx = base + binned.astype(jnp.int32)      # [n, F]
-                # inactive rows got rel == num_nodes -> index >=
+                # inactive rows got rel == hist_nodes -> index >=
                 # num_segments, dropped by the scatter
                 gb = jnp.broadcast_to(g[:, None], (n, F)).ravel()
                 hb = jnp.broadcast_to(h[:, None], (n, F)).ravel()
                 flat = idx.ravel()
                 G = jax.ops.segment_sum(
-                    gb, flat, num_segments=num_nodes * F * B)
+                    gb, flat, num_segments=hist_nodes * F * B)
                 H = jax.ops.segment_sum(
-                    hb, flat, num_segments=num_nodes * F * B)
-                G = G.reshape(num_nodes, F, B)
-                H = H.reshape(num_nodes, F, B)
+                    hb, flat, num_segments=hist_nodes * F * B)
+                G = G.reshape(hist_nodes, F, B)
+                H = H.reshape(hist_nodes, F, B)
             G = jax.lax.psum(G, DATA_AXIS)
             H = jax.lax.psum(H, DATA_AXIS)
             return G, H
@@ -358,17 +367,62 @@ class GbdtLearner:
             check_vma=False,  # pallas_call out_shape carries no vma
         )
 
+        def local_totals(g, h, relh):
+            """Per-pair (Σg, Σh) via a fused masked reduce + psum — the
+            LAST level needs only node totals for leaf values, so the
+            full (F, B) histogram pass (the round's single most
+            expensive level) is skipped entirely."""
+            sel = (jax.lax.broadcasted_iota(jnp.int32,
+                                            (hist_nodes, g.shape[0]), 0)
+                   == relh[None, :])
+            Gt = jnp.sum(jnp.where(sel, g[None, :], 0.0), axis=-1)
+            Ht = jnp.sum(jnp.where(sel, h[None, :], 0.0), axis=-1)
+            return (jax.lax.psum(Gt, DATA_AXIS),
+                    jax.lax.psum(Ht, DATA_AXIS))
+
+        totals = jax.shard_map(
+            local_totals, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+
         @jax.jit
-        def level_step(binned, g, h, node, active, trees):
+        def level_step(binned, g, h, node, active, trees, Gp, Hp):
             rel = jnp.where(active, node - offset, num_nodes).astype(jnp.int32)
-            G, H = hist(binned, g, h, rel)
+            if sibling:
+                # accumulate left children only (even rel -> pair id)
+                relh = jnp.where(active & (rel % 2 == 0), rel // 2,
+                                 hist_nodes).astype(jnp.int32)
+                if last:
+                    # leaf-only level: totals suffice (see local_totals)
+                    Gt_l, Ht_l = totals(g, h, relh)
+                    Gt_p = Gp[:, 0, :].sum(-1)
+                    Ht_p = Hp[:, 0, :].sum(-1)
+                    Gt = jnp.stack([Gt_l, Gt_p - Gt_l], 1).reshape(
+                        num_nodes)
+                    Ht = jnp.stack([Ht_l, Ht_p - Ht_l], 1).reshape(
+                        num_nodes)
+                    leaf = -Gt / (Ht + lam) * eta
+                    sl = slice(offset, offset + num_nodes)
+                    trees = dict(trees)
+                    trees["leaf_value"] = trees["leaf_value"].at[sl].set(
+                        leaf)
+                    return node, jnp.zeros_like(active), trees, Gp, Hp
+                Gl, Hl = hist(binned, g, h, relh)
+                G = jnp.stack([Gl, Gp - Gl], axis=1).reshape(
+                    num_nodes, F, B)
+                H = jnp.stack([Hl, Hp - Hl], axis=1).reshape(
+                    num_nodes, F, B)
+            else:
+                G, H = hist(binned, g, h, rel)
             Gt, Ht = G[:, 0, :].sum(-1), H[:, 0, :].sum(-1)   # node totals
             leaf = -Gt / (Ht + lam) * eta
             sl = slice(offset, offset + num_nodes)
             if last:
                 trees = dict(trees)
                 trees["leaf_value"] = trees["leaf_value"].at[sl].set(leaf)
-                return node, jnp.zeros_like(active), trees
+                return node, jnp.zeros_like(active), trees, G, H
             # candidate splits: left = bins <= b (cumulative), right = rest
             GL = jnp.cumsum(G, axis=2)
             HL = jnp.cumsum(H, axis=2)
@@ -399,7 +453,7 @@ class GbdtLearner:
             node = jnp.where(splitting,
                              2 * node + 1 + (bv > thr).astype(jnp.int32),
                              node)
-            return node, splitting, trees
+            return node, splitting, trees, G, H
 
         self._level_fns[key] = level_step
         return level_step
@@ -430,12 +484,17 @@ class GbdtLearner:
             }
             node = jnp.zeros(label.shape, jnp.int32)
             active = mask > 0
+            # parent histograms thread level-to-level for the sibling
+            # subtraction (level 0 ignores the zero placeholder)
+            F, B = cfg.dim, cfg.max_bin
+            Gp = jnp.zeros((1, F, B), jnp.float32)
+            Hp = jnp.zeros((1, F, B), jnp.float32)
             for d in range(cfg.max_depth + 1):
                 num_nodes, offset = 2 ** d, 2 ** d - 1
                 fn_l = self._level_fn(num_nodes, offset,
                                       last=(d == cfg.max_depth))
-                node, active, trees = fn_l(binned, g, h, node, active,
-                                           trees)
+                node, active, trees, Gp, Hp = fn_l(binned, g, h, node,
+                                                   active, trees, Gp, Hp)
             _, _, _, leaf = _tree_lookup(node, trees, T)
             margin2 = margin + leaf
             return trees, node, margin2
