@@ -264,3 +264,43 @@ def test_v_aliasing_measured_and_bounded(fm_file):
     # ...and at this collision level the quality cost is bounded: a few
     # percent of logloss, not a cliff
     assert ll_alias - ll_exact < 0.08, (ll_exact, ll_alias, r_alias)
+
+
+def test_fm_pack_row_overflow_drops_from_both_layouts():
+    """A row with more live V nonzeros than nnz_per_row overflows the
+    row-major layout; the overflow must be dropped from BOTH the rm
+    arrays and the slot-sorted COO (else the forward and the push would
+    disagree about which interactions exist)."""
+    import types
+
+    from wormhole_tpu.ops import coo_kernels as ck
+
+    W = 4
+    cfg = DifactoConfig(minibatch=8, num_buckets=2 * ck.TILE,
+                        v_buckets=ck.TILE, nnz_per_row=W, dim=4,
+                        threshold=0, kernel="pallas", kernel_dtype="f32")
+    lrn = DifactoLearner(cfg, make_mesh(1, 1))
+    # row 0 carries 7 live nonzeros (> W); rows 1..7 carry 2 each
+    segs, idxs, vals = [], [], []
+    for j in range(7):
+        segs.append(0); idxs.append(11 + j); vals.append(1.0 + j)
+    for r in range(1, 8):
+        for j in range(2):
+            segs.append(r); idxs.append(100 + 10 * r + j); vals.append(1.0)
+    seg = np.array(segs, np.int32)
+    idx = np.array(idxs, np.int64)
+    val = np.array(vals, np.float32)
+    db = types.SimpleNamespace(seg=seg, idx=idx, val=val)
+    pk = lrn._pack_fm(db, train=True)
+    (_, _, _, ts_v, _, vcoo, rm_slot, rm_val) = pk
+    rm_val2 = rm_val.reshape(cfg.minibatch, W)
+    # row 0 keeps exactly W of its 7 interactions...
+    assert np.count_nonzero(rm_val2[0]) == W
+    # ...and the slot COO keeps the SAME multiset of values per row
+    live = vcoo.val != 0
+    coo_row0 = np.sort(vcoo.val[live & (vcoo.seg == 0)])
+    np.testing.assert_array_equal(coo_row0, np.sort(rm_val2[0]))
+    # untouched rows are intact in both layouts
+    for r in range(1, 8):
+        assert np.count_nonzero(rm_val2[r]) == 2
+        assert np.count_nonzero(vcoo.val[live & (vcoo.seg == r)]) == 2

@@ -1,6 +1,7 @@
-"""FM (SpMM) Pallas kernels: the vector-valued pull (xv, x2v2) and push
-(gV) must match the XLA segment-op formulation exactly in f32 interpret
-mode — the FM hot path of reference difacto loss.h:53-157."""
+"""FM (SpMM) hot path: the row-major forward (gather + reshape-reduce)
+and the fm_push_contrib tile scatter must match the per-nnz reference
+accumulation exactly in f32 interpret mode — the FM hot path of
+reference difacto loss.h:53-157."""
 
 import numpy as np
 import pytest
@@ -20,18 +21,23 @@ def _pack_v(rng, nnz, num_rows, vrows, cap):
     return idx, seg, val, p
 
 
-def test_fm_pull_matches_xla():
+def test_fm_forward_row_major_matches_reference():
+    """The row-major FM forward (XLA gather + reshape-reduce over a
+    [rows, nnz_per_row] padded layout — models/difacto.forward) must
+    reproduce the per-nnz accumulation exactly."""
     rng = np.random.default_rng(5)
-    num_rows, vrows, dim, nnz = 256, 4 * ck.TILE_HI, 8, 3000
-    idx, seg, val, p = _pack_v(rng, nnz, num_rows, vrows, 8192)
-    V = rng.normal(size=(vrows, dim)).astype(np.float32)
+    num_rows, vrows, dim, W = 256, 4 * ck.TILE_HI, 8, 12
+    nnz = num_rows * W
+    idx = rng.integers(0, vrows, size=nnz).astype(np.int64)
+    seg = np.repeat(np.arange(num_rows, dtype=np.int32), W)
+    val = rng.normal(size=nnz).astype(np.float32)
+    V = rng.normal(size=(vrows + 1, dim)).astype(np.float32)
+    V[-1] = 0.0  # the appended sentinel zero row
 
-    xv_img, x2_img = ck.fm_pull(jnp.asarray(V), jnp.asarray(p.idx),
-                                jnp.asarray(p.seg), jnp.asarray(p.val),
-                                jnp.asarray(p.tmap), jnp.asarray(p.first),
-                                num_rows, dtype=jnp.float32)
-    xv = np.asarray(ck.fm_rows(xv_img))
-    x2 = np.asarray(ck.fm_rows(x2_img))
+    V_nnz = np.asarray(jnp.take(jnp.asarray(V), jnp.asarray(idx), axis=0))
+    p = val[:, None] * V_nnz
+    xv = p.reshape(num_rows, W, dim).sum(1)
+    x2 = (p * p).reshape(num_rows, W, dim).sum(1)
 
     xv_ref = np.zeros((num_rows, dim), np.float32)
     x2_ref = np.zeros((num_rows, dim), np.float32)
@@ -42,25 +48,29 @@ def test_fm_pull_matches_xla():
     np.testing.assert_allclose(x2, x2_ref, rtol=1e-4, atol=1e-4)
 
 
-def test_fm_push_matches_xla():
+def test_fm_push_contrib_matches_reference():
+    """fm_push_contrib (the row-major path's tile scatter with
+    precomputed a = c*xv[seg], b = c*val) must equal the dense per-nnz
+    dV accumulation; padding entries (val = 0) must vanish."""
     rng = np.random.default_rng(6)
     num_rows, vrows, dim, nnz = 256, 4 * ck.TILE_HI, 8, 3000
     idx, seg, val, p = _pack_v(rng, nnz, num_rows, vrows, 8192)
     V = rng.normal(size=(vrows, dim)).astype(np.float32)
     d = rng.normal(size=num_rows).astype(np.float32)
 
-    xv_img, _ = ck.fm_pull(jnp.asarray(V), jnp.asarray(p.idx),
-                           jnp.asarray(p.seg), jnp.asarray(p.val),
-                           jnp.asarray(p.tmap), jnp.asarray(p.first),
-                           num_rows, dtype=jnp.float32)
-    gV = np.asarray(ck.fm_push(jnp.asarray(V), jnp.asarray(d), xv_img,
-                               jnp.asarray(p.idx), jnp.asarray(p.seg),
-                               jnp.asarray(p.val), jnp.asarray(p.tmap),
-                               jnp.asarray(p.first), dtype=jnp.float32))
-
     xv_ref = np.zeros((num_rows, dim), np.float32)
     for j in range(nnz):
         xv_ref[seg[j]] += val[j] * V[idx[j]]
+    # kernel operands from the packed (sorted+padded) layout: padding
+    # entries carry val == 0, so their a/b are zero
+    c = d[p.seg] * p.val
+    a = c[:, None] * xv_ref[p.seg]
+    b = c * p.val
+    gV = np.asarray(ck.fm_push_contrib(
+        jnp.asarray(V), jnp.asarray(a.astype(np.float32)),
+        jnp.asarray(b.astype(np.float32)), jnp.asarray(p.idx),
+        jnp.asarray(p.tmap), jnp.asarray(p.first), dtype=jnp.float32))
+
     gV_ref = np.zeros((vrows, dim), np.float32)
     for j in range(nnz):
         gV_ref[idx[j]] += d[seg[j]] * val[j] * (

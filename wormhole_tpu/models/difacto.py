@@ -419,6 +419,41 @@ class DifactoLearner:
         keepv = vslot_nz < uv_cap
         dropped += int(np.count_nonzero(~keepv & (vval != 0)))
         segv, vvalv, vslotv = seg[keepv], vval[keepv], vslot_nz[keepv]
+        # row-major padded view (minibatch x nnz_per_row) of the live V
+        # nonzeros: the forward's xv/x2 sums become an XLA row gather +
+        # dense reshape-reduce over this layout instead of the radix-
+        # image scatter matmuls (the old fm_pull wall). Slot `uv_cap`
+        # is the appended zero row of the compact table.
+        W = cfg.nnz_per_row
+        mb = cfg.minibatch
+        rm_slot = np.full(mb * W, uv_cap, np.int32)
+        rm_val = np.zeros(mb * W, np.float32)
+        nzv = vvalv != 0
+        # db.seg is CSR-derived and nondecreasing, and boolean masks
+        # preserve order — so the live entries are already row-grouped
+        # (asserted; a sort here would be a wasted O(nnz) pass per batch
+        # on the loader threads)
+        seg_nz, slot_nz2, val_nz = segv[nzv], vslotv[nzv], vvalv[nzv]
+        assert seg_nz.size == 0 or (np.diff(seg_nz) >= 0).all(), \
+            "rm pack expects row-grouped nonzeros (CSR order)"
+        pos = (np.arange(seg_nz.shape[0])
+               - np.searchsorted(seg_nz, seg_nz, side="left"))
+        fit = pos < W
+        if not fit.all():
+            # a row carries more live nonzeros than nnz_per_row: drop
+            # the overflow from BOTH layouts so pull and push agree
+            nz_pos = np.flatnonzero(nzv)
+            vvalv[nz_pos[~fit]] = 0.0
+            n_over = int(np.count_nonzero(~fit))
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fm row overflow: dropped %d interactions from rows "
+                "with more than nnz_per_row=%d live V nonzeros — raise "
+                "nnz_per_row to keep them", n_over, W)
+        rm_index = seg_nz[fit] * W + pos[fit]
+        rm_slot[rm_index] = slot_nz2[fit]
+        rm_val[rm_index] = val_nz[fit]
         vtouched = np.zeros(uv_cap, np.float32)
         vtouched[np.unique(vslotv[vvalv != 0])] = 1.0
         vcoo = ck.pack_sorted_coo(vslotv, segv, vvalv, uv_cap,
@@ -431,7 +466,7 @@ class DifactoLearner:
                 "fm compaction overflow: dropped %d nonzeros — raise "
                 "the first batch's key diversity (caps %s)",
                 dropped, self._fm_caps)
-        return (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo)
+        return (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo, rm_slot, rm_val)
 
     def _build_fm(self, uw_cap: int, uv_cap: int) -> None:
         cfg = self.cfg
@@ -449,26 +484,36 @@ class DifactoLearner:
 
         def forward(wc, Vc, pk_dev):
             (widx, wseg, wval, wtmap, wfirst,
-             vidx, vseg, vval, vtmap, vfirst) = pk_dev
+             vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val) = pk_dev
             xw = ck.coo_spmv(wc, widx, wseg, wval, wtmap, wfirst,
                              cfg.minibatch, dtype=dt)
-            xv_img, x2_img = ck.fm_pull(Vc, vidx, vseg, vval, vtmap,
-                                        vfirst, cfg.minibatch, dtype=dt)
-            xv = ck.fm_rows(xv_img)
-            x2 = ck.fm_rows(x2_img)
+            # row-major forward: one XLA row gather of the compact V
+            # rows + a dense reshape-reduce. Replaces fm_pull's radix-
+            # image scatter matmuls, whose (R, BLK) x (BLK, 2*dim*128)
+            # dots were the DiFacto step's MXU wall (PERF.md). The
+            # gather moves rows at the kernel dtype (half the bytes in
+            # bf16 mode — gathers are bandwidth-bound); products and
+            # sums accumulate in f32.
+            Vcz = jnp.concatenate(
+                [Vc.astype(dt), jnp.zeros((1, cfg.dim), dt)], axis=0)
+            V_nnz = jnp.take(Vcz, rm_slot, axis=0)        # [mb*W, dim]
+            p = rm_val[:, None] * V_nnz.astype(jnp.float32)
+            xv = p.reshape(cfg.minibatch, -1, cfg.dim).sum(1)
+            x2 = (p * p).reshape(cfg.minibatch, -1, cfg.dim).sum(1)
             margin = xw + 0.5 * jnp.sum(xv * xv - x2, axis=-1)
-            return xw, xv_img, margin
+            return xw, xv, margin
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def train_fm(state, vstate, uniq_w, wtm, wfi, wla, wcnts,
                      widx, wseg, wval, wtmap, wfirst,
                      uniq_v, vtm, vfi, vla, vtouched,
-                     vidx, vseg, vval, vtmap, vfirst, label, mask, rngkey):
+                     vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val,
+                     label, mask, rngkey):
             wc, Vc = gather_compact(state, vstate, uniq_w, wtm,
                                     uniq_v, vtm)
             pk_dev = (widx, wseg, wval, wtmap, wfirst,
-                      vidx, vseg, vval, vtmap, vfirst)
-            xw, xv_img, margin = forward(wc, Vc, pk_dev)
+                      vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val)
+            xw, xv, margin = forward(wc, Vc, pk_dev)
             obj, d = linmod._loss_dual(cfg.loss, label, margin)
             d = d * mask
 
@@ -476,22 +521,33 @@ class DifactoLearner:
             # inside the fused kernel over touched tiles, in place
             gw = ck.coo_spmv_t(d, widx, wseg, wval, wtmap, wfirst,
                                uw_cap, dtype=dt)
+            # cnt rides the fused update's touched-tile walk as an
+            # additive table (an XLA element scatter into the 4M-bucket
+            # table costs ~4 ms at the Criteo shape; sentinel slots
+            # carry all-zero one-hot rows and scatter nothing)
             new_state, new_w = scatter_update(
                 "ftrl", state, gw, uniq_w, wtm, wfi, wla,
                 lr_eta=cfg.lr_eta, lr_beta=cfg.lr_beta,
                 lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
-                fixed_bytes=cfg.fixed_bytes, dtype=dt)
-            # counts are additive: one sorted-unique scatter-add
-            # no unique_indices hint: the sentinel index repeats in every
-            # alignment hole, and lying to the scatter about uniqueness is
-            # undefined behavior
-            new_state["cnt"] = state["cnt"].at[uniq_w].add(
-                wcnts, mode="drop")
+                fixed_bytes=cfg.fixed_bytes, dtype=dt,
+                add_table="cnt", add_values=wcnts)
 
             # V: AdaGrad at the row's storage, same treatment; the grad
-            # filters apply on the compact gradient beforehand
-            gV = ck.fm_push(Vc, d, xv_img, vidx, vseg, vval, vtmap,
-                            vfirst, dtype=dt)
+            # filters apply on the compact gradient beforehand.
+            # dV_j += sum_i c*(xv_i - val*V_j), c = d_i*val: the xv and
+            # d factors ride ONE row gather from the [mb, dim+1] row
+            # layout (padding entries carry val = 0 and vanish); the
+            # kernel only re-derives tile V rows and scatters.
+            xvd = jnp.concatenate([xv, d[:, None]], axis=1).astype(dt)
+            G = jnp.take(xvd, vseg, axis=0)
+            c = G[:, cfg.dim].astype(jnp.float32) * vval
+            # kernel operands at the kernel dtype: the contrib matmul
+            # runs in dt anyway, so f32 a/b would only double the wire
+            a = (c[:, None] * G[:, :cfg.dim].astype(jnp.float32)
+                 ).astype(dt)
+            b = (c * vval).astype(dt)
+            gV = ck.fm_push_contrib(Vc, a, b, vidx, vtmap, vfirst,
+                                    dtype=dt)
             if cfg.grad_normalization:
                 gV = gV / jnp.maximum(jnp.sum(mask), 1.0)
             if cfg.grad_clipping > 0:
@@ -517,11 +573,11 @@ class DifactoLearner:
         @jax.jit
         def fwd_fm(state, vstate, uniq_w, wtm, widx, wseg, wval, wtmap,
                    wfirst, uniq_v, vtm, vidx, vseg, vval, vtmap, vfirst,
-                   label, mask):
+                   rm_slot, rm_val, label, mask):
             wc, Vc = gather_compact(state, vstate, uniq_w, wtm,
                                     uniq_v, vtm)
             pk_dev = (widx, wseg, wval, wtmap, wfirst,
-                      vidx, vseg, vval, vtmap, vfirst)
+                      vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val)
             _, _, margin = forward(wc, Vc, pk_dev)
             obj, _ = linmod._loss_dual(cfg.loss, label, margin)
             return margin, linmod._progress(obj, margin, label, mask)
@@ -550,12 +606,12 @@ class DifactoLearner:
         return ("fm", args, blk.size, train, ids)
 
     def _fm_args(self, pk, label, mask, train: bool):
-        ts_w, wcnts, wcoo, ts_v, vtouched, vcoo = pk
+        (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo, rm_slot, rm_val) = pk
         j = jnp.asarray
         wparts = [j(wcoo.idx), j(wcoo.seg), j(wcoo.val), j(wcoo.tmap),
                   j(wcoo.first)]
         vparts = [j(vcoo.idx), j(vcoo.seg), j(vcoo.val), j(vcoo.tmap),
-                  j(vcoo.first)]
+                  j(vcoo.first), j(rm_slot), j(rm_val)]
         if train:
             return ([j(ts_w.uniq), j(ts_w.tmap_u), j(ts_w.first_u),
                      j(ts_w.last_u), j(wcnts)] + wparts

@@ -539,106 +539,18 @@ def tile_gather(table2, uniq, tmap_u, dtype=None):
 # shape on v5e.
 
 
-# channel-group width for the wide-N scatter matmuls: enough lanes to
-# keep the MXU busy, small enough that the (BLK, group) operand and the
-# (R, group) accumulator stay inside scoped VMEM at any dim
-_FM_GROUP = 16  # k-channels per matmul group (16 * 128 = 2048 lanes)
-
-
-def _fm_pull_kernel(tmap_ref, first_ref, V_ref, idx_ref, seg_ref, val_ref,
-                    out_ref, *, num_rows: int, dim: int, dtype):
-    # out_ref: (R, 2*dim*LANES) — xv_k images in lane groups [k*128,
-    # (k+1)*128), then x2_k images. One wide-N matmul per channel group
-    # replaces the former per-k (R, BLK) @ (BLK, 128) loop, whose skinny
-    # N=128 matmuls left the MXU mostly idle.
-    blk = pl.program_id(0)
-
-    @pl.when(blk == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    local = idx_ref[:] - tmap_ref[blk] * TILE_HI
-    e = _onehot(local, TILE_HI, dtype)
-    rows = jax.lax.dot_general(
-        e, V_ref[:].astype(dtype),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_prec(dtype),
-    )                                            # [BLK, dim]
-    p = val_ref[:][:, None] * rows
-    p2 = p * p                                   # (val V)^2 = val^2 V^2
-    rhi = seg_ref[:] >> 7
-    rlo = seg_ref[:] & (LANES - 1)
-    e_rt = _onehot_t(rhi, num_rows // LANES, dtype)
-    c_r = _onehot(rlo, LANES, dtype)
-
-    def chan(k):
-        # static slices: Mosaic's gather rule rejects integer indexing
-        # on the minor (dim) axis
-        src, kk = (p, k) if k < dim else (p2, k - dim)
-        return jax.lax.slice_in_dim(src, kk, kk + 1, axis=1) * c_r
-
-    for g0 in range(0, 2 * dim, _FM_GROUP):
-        g1 = min(g0 + _FM_GROUP, 2 * dim)
-        # built lazily per group so at most _FM_GROUP (BLK, 128) channel
-        # operands are live at once
-        rhs = jnp.concatenate([chan(k) for k in range(g0, g1)], axis=1)
-        got = jax.lax.dot_general(
-            e_rt, rhs.astype(dtype),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=_prec(dtype),
-        )
-        out_ref[:, g0 * LANES:g1 * LANES] += got
-
-
-def fm_pull(V, sidx, sseg, sval, tmap, first, num_rows: int, dtype=None):
-    """FM forward sums over a V-slot-sorted COO batch.
-
-    V: [rows, dim] compact embedding table (rows % TILE_HI == 0).
-    Returns (xv, x2v2) in radix layout [dim, num_rows//128, 128];
-    `fm_rows(x)` converts to [num_rows, dim]."""
-    if dtype is None:
-        dtype = jnp.bfloat16 if not _use_interpret() else jnp.float32
-    rows, dim = V.shape
-    assert rows % TILE_HI == 0 and num_rows % LANES == 0
-    nblk = tmap.shape[0]
-    R = num_rows // LANES
-    blk = sidx.shape[0] // nblk
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((TILE_HI, dim), lambda b, tmap, first: (tmap[b], 0)),
-            pl.BlockSpec((blk,), lambda b, *_: (b,)),
-            pl.BlockSpec((blk,), lambda b, *_: (b,)),
-            pl.BlockSpec((blk,), lambda b, *_: (b,)),
-        ],
-        out_specs=pl.BlockSpec((R, 2 * dim * LANES), lambda b, *_: (0, 0)),
-    )
-    out = pl.pallas_call(
-        partial(_fm_pull_kernel, num_rows=num_rows, dim=dim, dtype=dtype),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((R, 2 * dim * LANES), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_FM_VMEM_LIMIT),
-        interpret=_use_interpret(),
-    )(tmap, first, V, sidx, sseg, sval)
-    img = out.reshape(R, 2 * dim, LANES).transpose(1, 0, 2)
-    return img[:dim], img[dim:]
-
-
-def fm_rows(x) -> jax.Array:
-    """[dim, R, 128] radix image -> [R * 128, dim] row layout."""
-    dim, R, L = x.shape
-    return x.transpose(1, 2, 0).reshape(R * L, dim)
-
-
-def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, xv_ref,
-                    idx_ref, seg_ref, val_ref, out_ref, *,
-                    dim: int, dtype):
-    # xv_ref: (R, dim*LANES) — fm_pull's xv images concatenated along
-    # lanes, so one wide-N matmul per chunk fetches all dim channels
+def _fm_push_contrib_kernel(tmap_ref, first_ref, V_ref, a_ref, b_ref,
+                            idx_ref, out_ref, *, dim: int, dtype):
+    # The row-major FM path's scatter: per-nnz contributions arrive
+    # PRECOMPUTED (a = c*xv[seg], b = c*val with c = d[seg]*val — both
+    # built by cheap XLA row gathers from the [rows, dim] xv, since the
+    # forward keeps xv in row layout), so this kernel only re-derives
+    # the V rows it already streams per tile and scatters
+    #   dV_tile += e_t @ (a - b*vrows)
+    # Replaces _fm_push_kernel's in-kernel one-hot fetch of the
+    # (R, dim*128) radix images — the MXU wall of the old scheme (the
+    # fetch matmul's K was the whole image height; here every matmul is
+    # (BLK, TILE_HI) x (TILE_HI, dim)).
     blk = pl.program_id(0)
 
     @pl.when(first_ref[blk] == 1)
@@ -653,36 +565,8 @@ def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, xv_ref,
         preferred_element_type=jnp.float32,
         precision=_prec(dtype),
     )                                             # [BLK, dim]
-    rhi = seg_ref[:] >> 7
-    rlo = seg_ref[:] & (LANES - 1)
-    c_rlo = _onehot(rlo, LANES, dtype)
-    d_j = _lane_pick(_row_fetch(d_ref[:], rhi, dtype), c_rlo)
-    # fetch xv[seg] for all dim channels, chunked along the nnz axis so
-    # the (chunk, dim*128) fetch temporaries stay within scoped VMEM
-    nnz_blk = rhi.shape[0]
-    ch = max(LANES, min(1024, 8192 // dim))
-    ch = min(ch, nnz_blk)
-    y_chunks = []
-    for c0 in range(0, nnz_blk, ch):
-        hi_end = min(c0 + ch, nnz_blk)
-        rhi_c = jax.lax.slice_in_dim(rhi, c0, hi_end)
-        c_rlo_c = jax.lax.slice_in_dim(c_rlo, c0, hi_end, axis=0)
-        e_rc = _onehot(rhi_c, d_ref.shape[0], dtype)
-        t = jax.lax.dot_general(
-            e_rc, xv_ref[:].astype(dtype),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=_prec(dtype),
-        )                                         # [ch, dim*128]
-        ys = [_lane_pick(
-            jax.lax.slice_in_dim(t, k * LANES, (k + 1) * LANES, axis=1),
-            c_rlo_c) for k in range(dim)]
-        y_chunks.append(jnp.stack(ys, axis=1))
-    y = jnp.concatenate(y_chunks, axis=0)         # xv[seg]  [BLK, dim]
-    c = d_j * val_ref[:]
-    # dV = sum_i d_i x_ij (Xv_i - x_ij V_j)   (difacto loss.h:183-279)
+    contrib = a_ref[:] - b_ref[:][:, None] * vrows
     e_t = _onehot_t(local, TILE_HI, dtype)
-    contrib = c[:, None] * y - (c * val_ref[:])[:, None] * vrows
     out_ref[:] += jax.lax.dot_general(
         e_t, contrib.astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
@@ -691,45 +575,37 @@ def _fm_push_kernel(tmap_ref, first_ref, V_ref, d_ref, xv_ref,
     )
 
 
-def fm_push(V, d, xv, sidx, sseg, sval, tmap, first, dtype=None):
-    """FM embedding gradient over a V-slot-sorted COO batch.
-
-    d: [num_rows] dual; xv: [dim, R, 128] radix image (fm_pull's output).
-    Returns gV [rows, dim] in the compact table layout."""
+def fm_push_contrib(V, a, b, sidx, tmap, first, dtype=None):
+    """FM embedding gradient from precomputed per-nnz contributions
+    (row-major FM path): dV[j] += sum_nnz (a_nnz - b_nnz * V[j]) over the
+    slot-sorted COO. a: [P, dim] = c*xv[seg]; b: [P] = c*val (c =
+    d[seg]*val; padding entries carry val = 0, so they vanish)."""
     if dtype is None:
         dtype = jnp.bfloat16 if not _use_interpret() else jnp.float32
     rows, dim = V.shape
-    num_rows = d.shape[0]
-    assert rows % TILE_HI == 0 and num_rows % LANES == 0
+    assert rows % TILE_HI == 0
     nblk = tmap.shape[0]
-    R = num_rows // LANES
-    d2 = d.reshape(R, LANES)
     blk = sidx.shape[0] // nblk
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(nblk,),
         in_specs=[
-            pl.BlockSpec((TILE_HI, dim), lambda b, tmap, first: (tmap[b], 0)),
-            pl.BlockSpec((R, LANES), lambda b, *_: (0, 0)),
-            pl.BlockSpec((R, dim * LANES), lambda b, *_: (0, 0)),
-            pl.BlockSpec((blk,), lambda b, *_: (b,)),
-            pl.BlockSpec((blk,), lambda b, *_: (b,)),
-            pl.BlockSpec((blk,), lambda b, *_: (b,)),
+            pl.BlockSpec((TILE_HI, dim), lambda b_, tmap, first: (tmap[b_], 0)),
+            pl.BlockSpec((blk, dim), lambda b_, *_: (b_, 0)),
+            pl.BlockSpec((blk,), lambda b_, *_: (b_,)),
+            pl.BlockSpec((blk,), lambda b_, *_: (b_,)),
         ],
         out_specs=pl.BlockSpec((TILE_HI, dim),
-                               lambda b, tmap, first: (tmap[b], 0)),
+                               lambda b_, tmap, first: (tmap[b_], 0)),
     )
-    # xv arrives as the [dim, R, 128] stacked images; the kernel wants
-    # them lane-concatenated per row group
-    xv_wide = xv.transpose(1, 0, 2).reshape(R, dim * LANES)
     return pl.pallas_call(
-        partial(_fm_push_kernel, dim=dim, dtype=dtype),
+        partial(_fm_push_contrib_kernel, dim=dim, dtype=dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, dim), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_FM_VMEM_LIMIT),
         interpret=_use_interpret(),
-    )(tmap, first, V, d2, xv_wide, sidx, sseg, sval)
+    )(tmap, first, V, a, b, sidx)
 
 
 # ---------------------------------------------------------- mesh sharding

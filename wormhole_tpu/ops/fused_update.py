@@ -80,14 +80,19 @@ def _apply(algo: str, z, n, w, g, touched, *, lr_eta, lr_beta,
 
 
 def _kernel(tmap_ref, first_ref, last_ref, qscale_ref, g_ref, uniq_ref,
-            *refs, algo: str, dtype, fixed_bytes: int, hyper: dict):
-    # refs = state-in tiles, then state-out tiles (same count), then
-    # nw_out, then the g_acc scratch
-    n_tabs = (len(refs) - 2) // 2
+            *refs, algo: str, dtype, fixed_bytes: int, hyper: dict,
+            n_state: int, with_add: bool):
+    # refs = [add values (if with_add)] + state-in tiles (n_state, plus
+    # the additive table last if with_add), then the matching out tiles,
+    # then nw_out, then the g_acc scratch (+ add_acc scratch)
+    add_ref = refs[0] if with_add else None
+    refs = refs[1:] if with_add else refs
+    n_tabs = n_state + (1 if with_add else 0)
     in_refs = refs[:n_tabs]
     out_refs = refs[n_tabs:2 * n_tabs]
     nw_ref = refs[2 * n_tabs]
     acc_ref = refs[2 * n_tabs + 1]
+    add_acc = refs[2 * n_tabs + 2] if with_add else None
     b = pl.program_id(0)
 
     @pl.when(b == 0)
@@ -97,6 +102,8 @@ def _kernel(tmap_ref, first_ref, last_ref, qscale_ref, g_ref, uniq_ref,
     @pl.when(first_ref[b] == 1)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
+        if with_add:
+            add_acc[:] = jnp.zeros_like(add_acc)
         # copy-through so a partially-visited tile flushes its original
         # values, never uninitialized VMEM
         for i_ref, o_ref in zip(in_refs, out_refs):
@@ -116,6 +123,20 @@ def _kernel(tmap_ref, first_ref, last_ref, qscale_ref, g_ref, uniq_ref,
         preferred_element_type=jnp.float32,
         precision=_prec(dtype),
     )
+    if with_add:
+        # a second additive table (difacto's cnt) rides the same
+        # one-hots: scattering it here replaces an XLA element scatter
+        # into the full bucket table (~4 ms at the Criteo shape).
+        # Occurrence counts above 256 would round in bf16, so this
+        # matmul stays f32 regardless of the kernel dtype (counts are
+        # integers — exact in f32 up to 2^24).
+        add_acc[:] += jax.lax.dot_general(
+            e_t.astype(jnp.float32), add_ref[:][:, None] * c_lo.astype(
+                jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
 
     @pl.when(last_ref[b] == 1)
     def _():
@@ -128,13 +149,15 @@ def _kernel(tmap_ref, first_ref, last_ref, qscale_ref, g_ref, uniq_ref,
             touched = (raw_g != 0).astype(jnp.float32)
             z = None
             n = in_refs[0][:] if algo == "adagrad" else None
-            w = in_refs[-1][:]
+            w = in_refs[n_state - 1][:]
         w_old = w if algo != "ftrl" else in_refs[2][:]
         z2, n2, w2 = _apply(algo, z, n, w, g, touched, **hyper)
         outs = {"ftrl": (z2, n2, w2), "adagrad": (n2, w2),
                 "sgd": (w2,)}[algo]
-        for o_ref, v in zip(out_refs, outs):
+        for o_ref, v in zip(out_refs[:n_state], outs):
             o_ref[:] = v
+        if with_add:
+            out_refs[n_state][:] = in_refs[n_state][:] + add_acc[:]
         delta = (jnp.sum((w2 != 0).astype(jnp.float32))
                  - jnp.sum((w_old != 0).astype(jnp.float32)))
         nw_ref[:] += delta
@@ -212,11 +235,16 @@ def _v_update_kernel(tmap_ref, first_ref, last_ref, gV_ref, tch_ref,
     e_t = _onehot_t(hi, TILE_HI, dtype)
     # rhs: each compact row's dim gradient values at its lane window;
     # touched flags broadcast across the whole window (the reference
-    # updates the entire [w,V] entry when a row is pushed)
-    rhs = jnp.zeros((gV_ref.shape[0], LANES), jnp.float32)
-    for j in range(dim):
-        rhs = rhs + (jax.lax.slice_in_dim(gV_ref[:], j, j + 1, axis=1)
-                     * _onehot(off + j, LANES, jnp.float32))
+    # updates the entire [w,V] entry when a row is pushed). The lane
+    # offset takes only LANES/dim distinct values (off = dim * residue),
+    # and a row's target lane for channel j is exactly column
+    # residue*dim + j — so concatenating the residue-masked gradients
+    # IS the scatter image: no per-channel one-hot builds at all (the
+    # former dim-iteration loop was this kernel's VPU wall).
+    nres = LANES // dim
+    res = off // dim
+    masks = [(res == r).astype(jnp.float32)[:, None] for r in range(nres)]
+    rhs = jnp.concatenate([gV_ref[:] * m for m in masks], axis=1)
     win = _row_window(off, dim, jnp.float32)
     gacc[:] += jax.lax.dot_general(
         e_t, rhs.astype(dtype),
@@ -293,18 +321,28 @@ def v_scatter_update(Vflat, nVflat, gV, vtouched, uniq_rows, tmap_u,
 
 def scatter_update(algo: str, state: dict, g, uniq, tmap_u, first_u,
                    last_u, *, lr_eta, lr_beta, lambda_l1, lambda_l2,
-                   fixed_bytes: int = 0, dtype=None):
+                   fixed_bytes: int = 0, dtype=None, add_table=None,
+                   add_values=None):
     """Apply the algo's handle update to the touched tiles of the state
     tables, in place (aliased), driven by the tile-aligned compact
     gradient g. Returns (new_state, new_w) where new_w is the |w|_0
     delta of this step (reference progress.h new_w accounting).
 
     state holds flat (num_buckets,) tables: ftrl {w,z,n}, adagrad {w,n},
-    sgd {w}. g/uniq are (u_cap,) from coo_spmv_t / pack_tile_coo."""
+    sgd {w}. g/uniq are (u_cap,) from coo_spmv_t / pack_tile_coo.
+
+    add_table/add_values: an optional extra ADDITIVE table in the same
+    bucket space (difacto's cnt) updated as table[uniq] += values inside
+    the same touched-tile walk; `state[add_table]` is replaced with the
+    result."""
     if dtype is None:
         dtype = jnp.bfloat16 if not _use_interpret() else jnp.float32
     order = {"ftrl": ("z", "n", "w"), "adagrad": ("n", "w"),
              "sgd": ("w",)}[algo]
+    n_state = len(order)
+    with_add = add_table is not None
+    if with_add:
+        order = order + (add_table,)
     tabs = [state[k].reshape(-1, LANES) for k in order]
     nb = tmap_u.shape[0]
     num_buckets = tabs[0].shape[0] * LANES
@@ -318,32 +356,40 @@ def scatter_update(algo: str, state: dict, g, uniq, tmap_u, first_u,
     def tile_map(b, tmap, first, last, qs):
         return (tmap[b], 0)
 
+    add_specs = ([pl.BlockSpec((BLK_U,), lambda b, *_: (b,))]
+                 if with_add else [])
+    add_args = [add_values] if with_add else []
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((BLK_U,), lambda b, *_: (b,)),   # g
             pl.BlockSpec((BLK_U,), lambda b, *_: (b,)),   # uniq
-        ] + [pl.BlockSpec((TILE_HI, LANES), tile_map) for _ in tabs],
+        ] + add_specs
+        + [pl.BlockSpec((TILE_HI, LANES), tile_map) for _ in tabs],
         out_specs=[pl.BlockSpec((TILE_HI, LANES), tile_map)
                    for _ in tabs] + [
             pl.BlockSpec((8, LANES), lambda b, *_: (0, 0))],
-        scratch_shapes=[pltpu.VMEM((TILE_HI, LANES), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((TILE_HI, LANES), jnp.float32)]
+        + ([pltpu.VMEM((TILE_HI, LANES), jnp.float32)]
+           if with_add else []),
     )
     out_shapes = [jax.ShapeDtypeStruct((num_buckets // LANES, LANES),
                                        jnp.float32) for _ in tabs] + [
         jax.ShapeDtypeStruct((8, LANES), jnp.float32)]
     # alias each state table input onto its output: flat input index =
-    # 4 scalar-prefetch args + 2 (g, uniq) + table position
-    aliases = {4 + 2 + i: i for i in range(len(tabs))}
+    # 4 scalar-prefetch args + 2 (g, uniq) + optional add values +
+    # table position
+    base_in = 4 + 2 + (1 if with_add else 0)
+    aliases = {base_in + i: i for i in range(len(tabs))}
     outs = pl.pallas_call(
         partial(_kernel, algo=algo, dtype=dtype, fixed_bytes=fixed_bytes,
-                hyper=hyper),
+                hyper=hyper, n_state=n_state, with_add=with_add),
         grid_spec=grid_spec,
         out_shape=out_shapes,
         input_output_aliases=aliases,
         interpret=_use_interpret(),
-    )(tmap_u, first_u, last_u, qscale, g, uniq, *tabs)
+    )(tmap_u, first_u, last_u, qscale, g, uniq, *add_args, *tabs)
     new_tabs, nw = outs[:-1], outs[-1]
     new_state = dict(state)
     for k, t in zip(order, new_tabs):
