@@ -323,8 +323,10 @@ def bench_kmeans(steps=30):
 
     mb, d, k, nnz_row = 16384, 784, 10, 160
     cfg = KmeansConfig(num_clusters=k, dim=d, minibatch=mb,
-                       nnz_per_row=nnz_row)
+                       nnz_per_row=nnz_row,
+                       kernel_dtype="bf16")  # documented opt-in
     lrn = KmeansLearner(cfg, make_mesh(num_data=1, num_model=1))
+    assert lrn._use_packed  # the run loop's fast path at this shape
     rng = np.random.default_rng(2)
     # MNIST-ish: ~20% dense nonzeros
     nnz = mb * nnz_row
@@ -333,9 +335,8 @@ def bench_kmeans(steps=30):
     for _ in range(4):
         idx = rng.integers(0, d, size=nnz).astype(np.int32)
         val = rng.random(nnz).astype(np.float32)
-        mask = np.ones(mb, np.float32)
-        put = lambda x: jax.device_put(jnp.asarray(x), lrn._bsh)
-        batches.append((put(seg), put(idx), put(val), put(mask)))
+        mask = jax.device_put(jnp.ones(mb, jnp.float32), lrn._bsh)
+        batches.append((lrn.pack_batch(seg, idx, val), mask))
     C = jnp.asarray(rng.standard_normal((k, d)).astype(np.float32))
 
     def run_chain(n):
@@ -343,8 +344,8 @@ def bench_kmeans(steps=30):
         cost = None
         Cl = C
         for i in range(n):
-            sums, counts, cost = lrn._assign_accumulate(
-                Cl, *batches[i % len(batches)])
+            pk, mask = batches[i % len(batches)]
+            sums, counts, cost = lrn._assign_packed(Cl, *pk, mask)
             Cl = sums / jnp.maximum(counts[:, None], 1.0)
         float(cost)
         C = Cl

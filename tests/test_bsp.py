@@ -257,3 +257,28 @@ def test_kmeans_sparse_end_to_end(tmp_path):
     cost_dense = run("dense")
     assert cost_sparse < 0.9  # clusters actually found (cosine dist)
     assert abs(cost_sparse - cost_dense) < 0.05
+
+
+def test_kmeans_packed_assign_matches_dense(tmp_path):
+    """The flat-bucket packed densify (coo_spmv_t over row*stride+col
+    buckets — the dense path's fast kernel) must reproduce the XLA
+    scatter densify exactly in f32."""
+    from tests.conftest import synth_libsvm_text
+    from wormhole_tpu.models.kmeans import KmeansConfig, KmeansLearner
+
+    f = tmp_path / "kmp.libsvm"
+    f.write_text(synth_libsvm_text(n_rows=256, n_feat=60, nnz_per_row=8,
+                                   seed=5))
+    cfg = KmeansConfig(train_data=str(f), num_clusters=4, minibatch=256,
+                       nnz_per_row=16, dim=60)
+    lrn = KmeansLearner(cfg)
+    rng = np.random.default_rng(0)
+    C = jnp.asarray(rng.standard_normal((4, 60)).astype(np.float32))
+    for b_raw, (pk, mask) in zip(lrn._batches(), lrn._batches_packed()):
+        s_d, c_d, cost_d = lrn._assign_dense(C, *b_raw)
+        s_p, c_p, cost_p = lrn._assign_packed(C, *pk, mask)
+        np.testing.assert_allclose(np.asarray(s_p), np.asarray(s_d),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(c_p), np.asarray(c_d))
+        np.testing.assert_allclose(float(cost_p), float(cost_d),
+                                   rtol=1e-5)
