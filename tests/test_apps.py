@@ -792,3 +792,56 @@ global_mesh = 1
     # sorted multisets, tight tolerance (printed at 6 significant digits)
     np.testing.assert_allclose(np.sort(got), np.sort(single), atol=1e-5,
                                rtol=1e-4)
+
+
+def test_global_mesh_predict_difacto(train_files, tmp_path):
+    """DifactoLearner.global_predict_protocol through the launcher:
+    per-rank margin files totaling one row per val example, matching
+    single-process predict_batch margins on the same saved model."""
+    from wormhole_tpu.models.difacto import DifactoConfig, DifactoLearner
+    from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+
+    cfg = DifactoConfig(
+        train_data=f"{train_files}/train-.*",
+        val_data=f"{train_files}/val.libsvm",
+        algo="ftrl", dim=4, threshold=1, lambda_l1=0.5, minibatch=256,
+        num_buckets=16384, v_buckets=4096, max_data_pass=2,
+        model_out=f"{tmp_path}/fmpm")
+    s = MinibatchSolver(DifactoLearner(cfg), cfg, verbose=False)
+    s.run()
+    single_files = s.predict(f"{train_files}/val.libsvm",
+                             f"{tmp_path}/fsp")
+    single = np.concatenate([np.loadtxt(f, ndmin=1)
+                             for f in sorted(single_files)])
+
+    conf = tmp_path / "fgp.conf"
+    conf.write_text(f"""
+train_data = "{train_files}/train-.*"
+val_data = "{train_files}/val.libsvm"
+model_in = {tmp_path}/fmpm
+predict_out = {tmp_path}/fgp
+algo = ftrl
+dim = 4
+threshold = 1
+lambda_l1 = 0.5
+minibatch = 256
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = 0
+global_mesh = 1
+""")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "2", "-s", "0", "--node-timeout", "10", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.difacto", str(conf)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out_files = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("fgp_rank-"))
+    got = np.concatenate([np.loadtxt(tmp_path / f, ndmin=1)
+                          for f in out_files])
+    assert got.shape == single.shape, (got.shape, single.shape)
+    np.testing.assert_allclose(np.sort(got), np.sort(single), atol=1e-4,
+                               rtol=1e-3)
