@@ -255,6 +255,8 @@ def _global_predict(cfg, env, learner, global_args, empty, verbose) -> None:
     as the PS-mode per-rank predict)."""
     import os
 
+    import numpy as np
+
     from wormhole_tpu.data.minibatch import MinibatchIter
     from wormhole_tpu.parallel import multihost as mh
 
@@ -293,9 +295,7 @@ def _global_predict(cfg, env, learner, global_args, empty, verbose) -> None:
         local = mh.fetch_local_rows(margins, rank * local_rows,
                                     rank * local_rows + size)
         if prob:
-            import numpy as _np
-
-            local = 1.0 / (1.0 + _np.exp(-local))
+            local = 1.0 / (1.0 + np.exp(-local))
         with open(path(got[0]), "a") as fh:
             for m in local:
                 fh.write(f"{m:.6g}\n")

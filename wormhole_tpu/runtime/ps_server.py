@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import socket
 import socketserver
 import struct
@@ -243,6 +244,15 @@ class ServerNode:
         # rows dirty since the last derived recompute, per group:
         # list of shard-local index arrays, or "all" after a dense push
         self._dirty: dict[int, object] = {}
+        # spec-init bookkeeping: non-zero-init tables awaiting their
+        # arrays, per-table upload claims (name -> deadline), the full
+        # table shapes for the divergent-conf cross-check, and the
+        # post-checkpoint-load stamping state
+        self._pending: set[str] = set()
+        self._claims: dict[str, float] = {}
+        self._full_shapes: Optional[dict[str, list]] = None
+        self._loaded = False
+        self._stamped_all: set[int] = set()
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         self._srv = _PSServer((host, port), _PSHandler)
@@ -292,7 +302,6 @@ class ServerNode:
                     self.full_rows = {
                         k: int(n) for k, n in header["full_rows"].items()}
                     self.derived = header.get("derived") or {}
-                    self._pending = set()
                     self._create_group_meta()
                 return ({"ok": True, "known": known, "clock": self.clock},
                         {})
@@ -306,16 +315,12 @@ class ServerNode:
             # starting workers put exactly one copy on the wire, not N.
             # A dense init offer at the 2^26 operating point is ~768 MB
             # per worker, which this path never sends.
-            import time as _time
-
             with self._lock:
-                if not self.tables and not getattr(self, "_pending", None):
+                if not self.tables and not self._pending:
                     self.full_rows = {
                         k: int(s["shape"][0])
                         for k, s in header["specs"].items()}
                     self.derived = header.get("derived") or {}
-                    self._pending = set()
-                    self._claims: dict[str, float] = {}  # name -> deadline
                     self._full_shapes = {
                         k: [int(d) for d in s["shape"]]
                         for k, s in header["specs"].items()}
@@ -335,7 +340,7 @@ class ServerNode:
                     # mis-shaped pushes
                     want = {k: [int(d) for d in s["shape"]]
                             for k, s in header["specs"].items()}
-                    have = getattr(self, "_full_shapes", None)
+                    have = self._full_shapes
                     if have is not None and want != have:
                         return {"error":
                                 f"init spec mismatch: offered {want} vs "
@@ -345,30 +350,27 @@ class ServerNode:
                         # specs; adopt them from the first worker
                         self.derived = header.get("derived") or {}
                     self._stamp_nonspec_groups(header["specs"])
-                now = _time.monotonic()
-                claims = getattr(self, "_claims", {})
-                pending = getattr(self, "_pending", set())
+                now = time.monotonic()
                 # claim TTL must comfortably cover a slow upload of a
                 # multi-hundred-MB slice; expiry only matters when the
                 # claimant DIED, so generous is safe (a live claimant's
                 # init_arrays clears the claim)
-                need = sorted(k for k in pending
-                              if claims.get(k, 0.0) <= now)
+                need = sorted(k for k in self._pending
+                              if self._claims.get(k, 0.0) <= now)
                 for k in need:
-                    claims[k] = now + 300.0
-                return ({"ok": True, "known": not pending,
+                    self._claims[k] = now + 300.0
+                return ({"ok": True, "known": not self._pending,
                          "need": need, "clock": self.clock}, {})
         if op == "init_arrays":
             # second phase of init_spec: slices for the `need` tables;
             # first worker's arrays win, duplicates are dropped
             with self._lock:
-                pend = getattr(self, "_pending", set())
                 for k, v in arrays.items():
-                    if k in pend:
+                    if k in self._pending:
                         self.tables[k] = v.astype(np.float32)
-                        pend.discard(k)
-                        getattr(self, "_claims", {}).pop(k, None)
-                return {"ok": True, "known": not pend}, {}
+                        self._pending.discard(k)
+                        self._claims.pop(k, None)
+                return {"ok": True, "known": not self._pending}, {}
         if op == "pull":
             since = header.get("since")
             if since is None:
@@ -523,8 +525,6 @@ class ServerNode:
         where the load is zero; init_spec stamps those groups fully when
         a worker's spec names them (see _stamp_nonspec_groups)."""
         import glob
-        import json as _json
-
         from wormhole_tpu.utils.checkpoint import (load_parts, part_name,
                                                    save_prefix)
 
@@ -540,7 +540,7 @@ class ServerNode:
             if meta is not None:
                 self.full_rows = {
                     k: int(n) for k, n in
-                    _json.loads(bytes(meta.tobytes()).decode()).items()}
+                    json.loads(bytes(meta.tobytes()).decode()).items()}
                 shard_arrays = got
         if shard_arrays is None:
             arrays = load_parts(base, it)
@@ -554,10 +554,12 @@ class ServerNode:
         self._full_shapes = {
             k: [self.full_rows[k], *v.shape[1:]]
             for k, v in shard_arrays.items()}
+        self._loaded = True
+        # a pre-load init_spec may have left pending/claim state; the
+        # checkpoint supersedes it (a late init_arrays must not
+        # overwrite loaded tables)
         self._pending = set()
         self._claims = {}
-        self._loaded = True
-        self._stamped_all: set[int] = set()
         for k, v in shard_arrays.items():
             self.tables[k] = np.ascontiguousarray(v, np.float32)
         self._create_group_meta()
@@ -580,7 +582,7 @@ class ServerNode:
         names them: the worker's seeded init differs from the loaded
         values even at loaded-zero rows, so only a full-group pull makes
         its base mirror coherent (caller holds the lock)."""
-        if not getattr(self, "_loaded", False):
+        if not self._loaded:
             return
         for k, s in specs.items():
             if s.get("zero", True) or k in self.derived:
@@ -620,10 +622,8 @@ class ServerNode:
             path = part_name(base, it, self.rank) + ".npz"
         # __full_rows__ tag: lets a same-world server reload ONLY its own
         # part (ServerNode._load fast path); load_parts skips "__" keys
-        import json as _json
-
         tables["__full_rows__"] = np.frombuffer(
-            _json.dumps(self.full_rows).encode(), np.uint8).copy()
+            json.dumps(self.full_rows).encode(), np.uint8).copy()
         atomic_savez(path, compressed=True, **tables)
         return path
 
@@ -735,13 +735,11 @@ class PSClient:
         init, not later with misrouted row indices. At the 2^26-bucket
         FTRL operating point this turns a ~768 MB-per-worker startup
         push into a ~1 KB header exchange (VERDICT r3 item 2)."""
-        import time as _time
-
         self.full_rows = {k: int(v.shape[0]) for k, v in tables.items()}
         specs = {k: {"shape": list(v.shape), "zero": k in zero_names}
                  for k, v in tables.items()}
         for r in range(self.world):
-            deadline = _time.monotonic() + timeout
+            deadline = time.monotonic() + timeout
             while True:
                 h, _ = self._rpc(r, {"op": "init_spec", "specs": specs,
                                      "derived": derived or {}})
@@ -754,11 +752,11 @@ class PSClient:
                         self._slices({k: tables[k] for k in need}, r))
                     if h2.get("known"):
                         break
-                if _time.monotonic() > deadline:
+                if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"server {self.uris[r]} tables never completed "
                         "creation (claimant died repeatedly?)")
-                _time.sleep(0.1)
+                time.sleep(0.1)
 
     def pull(self) -> dict[str, np.ndarray]:
         """Dense full-table pull (startup / test convenience)."""
@@ -1007,9 +1005,7 @@ class SyncedStore:
         return groups, deltas
 
     def sync(self) -> None:
-        import time as _time
-
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         got = self._touched_groups()
         if got is None:
             got = self._scan_groups()
@@ -1017,11 +1013,11 @@ class SyncedStore:
         self.client.push_sparse(groups, deltas,
                                 fixed_bytes=self.fixed_bytes,
                                 compress=self.compress)
-        t1 = _time.perf_counter()
+        t1 = time.perf_counter()
         self._apply_pull()
         if self.perf is not None:
             self.perf.add("ps_push", t1 - t0)
-            self.perf.add("ps_pull", _time.perf_counter() - t1)
+            self.perf.add("ps_pull", time.perf_counter() - t1)
         self._steps = 0
         self.num_syncs += 1
 
