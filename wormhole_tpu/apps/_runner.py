@@ -103,10 +103,8 @@ def _run_scheduler_global(env) -> dict:
         seen_any = False
         while True:
             time.sleep(1.0)
-            with sched._lock:
-                workers = [n for n in sched._nodes if n.startswith("worker")]
-            seen_any = seen_any or bool(workers)
-            if seen_any and not workers:
+            seen_any = seen_any or bool(sched.live_workers())
+            if seen_any and not sched.live_workers():
                 return {}
             if not seen_any and time.monotonic() > startup_deadline:
                 raise RuntimeError(
@@ -390,17 +388,28 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
                   flush=True)
         # command the server group to save its shards, then release
         # everyone (IterScheduler::SaveModel -> kServerGroup parity)
+        if ps is not None and cfg.model_out:
+            paths = ps.save(cfg.model_out)
+            if verbose:
+                print(f"model saved: {paths}", flush=True)
+        sched.announce_shutdown()
+        # wait for the workers' TAIL work (final wire stats, per-rank
+        # predict) before tearing down the planes they still need —
+        # each worker deregisters with op=bye when done, and its
+        # liveness pings keep it visible until then. Drained means ALL
+        # `-n` workers registered and left: a pure-predict job
+        # (max_data_pass=0) reaches this point before slow-starting
+        # workers have even registered, and a fast worker's bye must
+        # not read as "everyone finished". Bounded so a worker that
+        # died (liveness eviction, no bye) or never came up cannot
+        # hold the job open.
+        drain_deadline = time.monotonic() + max(120.0,
+                                                sched.node_timeout * 4)
+        while (not sched.workers_drained(env.num_workers)
+               and time.monotonic() < drain_deadline):
+            time.sleep(0.2)
         if ps is not None:
-            if cfg.model_out:
-                paths = ps.save(cfg.model_out)
-                if verbose:
-                    print(f"model saved: {paths}", flush=True)
-            sched.announce_shutdown()
-            time.sleep(1.0)
             ps.shutdown()
-        else:
-            sched.announce_shutdown()
-            time.sleep(1.0)
         return result
     finally:
         sched.stop()
@@ -437,9 +446,19 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
     # all-workers-lost abort — kill a healthy single-worker job
     pinger = LivenessPinger(client)
     try:
-        return _run_worker_body(cfg, env, verbose, learner, client)
+        result = _run_worker_body(cfg, env, verbose, learner, client)
     finally:
         pinger.stop()
+    # deregister ONLY on clean completion, so the scheduler's shutdown
+    # drain sees the tail work (wire stats, predict) finished. A worker
+    # that CRASHES must instead time out of the liveness table — that
+    # eviction is what re-queues its in-flight parts (a bye from a
+    # crash path would silently disable the failure recovery).
+    try:
+        client.call(op="bye")
+    except Exception:
+        pass
+    return result
 
 
 def _run_worker_body(cfg, env, verbose, learner, client) -> dict:

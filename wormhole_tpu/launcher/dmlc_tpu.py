@@ -156,16 +156,24 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
         threads.append(t)
     try:
         rc = sched.wait()
-        # give workers a grace period to drain, then terminate leftovers
+        # give workers a grace period to drain, then terminate leftovers.
+        # A signal death is a NEGATIVE returncode — fold it to a
+        # nonzero exit instead of letting max() hide it behind a clean
+        # scheduler (a worker SIGTERM'd mid-predict must fail the job).
+        def fold(code: int) -> None:
+            nonlocal rc
+            if code != 0 and rc == 0:
+                rc = code if code > 0 else 1
         for p in workers + servers:
             try:
-                rc = max(rc, p.wait(timeout=10))
+                fold(p.wait(timeout=10))
             except subprocess.TimeoutExpired:
                 p.send_signal(signal.SIGTERM)
                 try:
-                    p.wait(timeout=5)
+                    fold(p.wait(timeout=5))
                 except subprocess.TimeoutExpired:
                     p.kill()
+                    fold(1)
         return rc
     finally:
         for p in procs.values():

@@ -112,7 +112,7 @@ class Scheduler:
         self._barrier_gen: dict[str, int] = {}   # name -> generation
         self._epoch = 0                          # bumped per dispatch round
         self._shutdown = False                   # job end; workers exit
-        self._seen_worker = False                # any worker ever registered
+        self._seen_workers: set[str] = set()     # workers ever registered
         self._blobs: dict[str, str] = {}         # rendezvous KV payloads
         self._done = False
         self._srv = _Server((host, port), _Handler)
@@ -227,9 +227,8 @@ class Scheduler:
         none_live_since: Optional[float] = None
         while not self._round_finished():
             time.sleep(min(0.2, print_sec))
-            with self._lock:
-                live = [n for n in self._nodes if n.startswith("worker")]
-            if self._seen_worker and not live:
+            live = self.live_workers()
+            if self._seen_workers and not live:
                 # every worker gone from the liveness table. Workers run
                 # a LivenessPinger, so eviction means real death — but
                 # grant one extra node_timeout of grace before aborting
@@ -269,7 +268,7 @@ class Scheduler:
         with self._lock:
             self._nodes[node] = time.monotonic()
             if node.startswith("worker"):
-                self._seen_worker = True
+                self._seen_workers.add(node)
         if op == "register":
             return {"ok": True, "epoch": self._epoch}
         if op == "register_server":
@@ -383,6 +382,21 @@ class Scheduler:
             return {"released": False, "gen": gen}
 
     # -- liveness -----------------------------------------------------------
+    def live_workers(self) -> list[str]:
+        """Workers currently in the liveness table."""
+        with self._lock:
+            return [n for n in self._nodes if n.startswith("worker")]
+
+    def workers_drained(self, expect: int) -> bool:
+        """True once `expect` distinct workers have registered AND none
+        remain live — the shutdown-drain condition (a fast worker's
+        deregistration must not read as 'everyone finished' while a
+        slow-starting peer has yet to register)."""
+        with self._lock:
+            if len(self._seen_workers) < expect:
+                return False
+            return not any(n.startswith("worker") for n in self._nodes)
+
     def _liveness_loop(self) -> None:
         while not self._done:
             time.sleep(min(self.node_timeout / 3, 5.0))
