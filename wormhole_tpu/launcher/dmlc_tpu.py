@@ -49,16 +49,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _default_host_ip() -> str:
+def _default_host_ip() -> str | None:
     """A launch-host address remote role processes can dial back to (the
     dmlc ssh tracker's socket.getsockname trick: no traffic is sent; the
-    OS just picks the outbound interface)."""
-    try:
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-            s.connect(("10.255.255.255", 1))
-            return s.getsockname()[0]
-    except OSError:
-        return "127.0.0.1"
+    OS just picks the outbound interface). Probes a routable target
+    first (as the dmlc tracker does); returns None when no interface
+    can be determined so the caller can fail loudly instead of handing
+    remote roles an undialable 127.0.0.1."""
+    for probe in ("8.8.8.8", "10.255.255.255"):
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((probe, 1))
+                ip = s.getsockname()[0]
+            if not ip.startswith("127."):
+                return ip
+        except OSError:
+            continue
+    return None
 
 
 def _stream(prefix: str, pipe, out):
@@ -90,8 +97,16 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     launch-host address the remote nodes can dial. The jax.distributed
     coordinator lands on hosts[0] (worker 0's host) at `coord_port`."""
     multi = bool(hosts)
-    sched_host = (scheduler_host or _default_host_ip()) if multi \
-        else "127.0.0.1"
+    if multi:
+        sched_host = scheduler_host or _default_host_ip()
+        if not sched_host:
+            raise RuntimeError(
+                "--hosts mode: could not auto-detect a launch-host IP the "
+                "remote roles can dial back to (every interface probe "
+                "failed or resolved to loopback); pass --scheduler-host "
+                "explicitly")
+    else:
+        sched_host = "127.0.0.1"
     uri = f"{sched_host}:{_free_port()}"
     # jax.distributed rendezvous for apps that opt into the global-mesh
     # mode (parallel/multihost.py); worker 0 binds it on first use. On a

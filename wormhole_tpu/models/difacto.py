@@ -431,11 +431,16 @@ class DifactoLearner:
         nzv = vvalv != 0
         # db.seg is CSR-derived and nondecreasing, and boolean masks
         # preserve order — so the live entries are already row-grouped
-        # (asserted; a sort here would be a wasted O(nnz) pass per batch
-        # on the loader threads)
+        # (checked with a hard error, not assert: an out-of-order seg
+        # would silently mispack rm_slot/rm_val and corrupt the FM
+        # forward, and -O must not strip the guard; a sort here would be
+        # a wasted O(nnz) pass per batch on the loader threads)
         seg_nz, slot_nz2, val_nz = segv[nzv], vslotv[nzv], vvalv[nzv]
-        assert seg_nz.size == 0 or (np.diff(seg_nz) >= 0).all(), \
-            "rm pack expects row-grouped nonzeros (CSR order)"
+        if seg_nz.size and not (np.diff(seg_nz) >= 0).all():
+            raise ValueError(
+                "fm row-major pack: segment ids are not row-grouped "
+                "(CSR order violated) — the input RowBlock's seg must "
+                "be nondecreasing")
         pos = (np.arange(seg_nz.shape[0])
                - np.searchsorted(seg_nz, seg_nz, side="left"))
         fit = pos < W

@@ -66,6 +66,12 @@ from wormhole_tpu.runtime.net import connect_with_retry
 # buffer ("nbytes" is then the compressed size, "rawbytes" the original).
 
 _COMPRESS_MIN = 512  # don't bother compressing tiny buffers
+# init_spec claim TTL: how long a server waits for a claimant's
+# init_arrays before handing the claim to the next poller. Clients wait
+# 2x this by default so at least one full re-claim cycle fits inside the
+# client deadline (a claimant dying right after claiming stays
+# recoverable instead of racing the waiters' own timeout).
+INIT_CLAIM_TTL = 300.0
 
 
 def _encode(a: np.ndarray, fixed_bytes: int = 0,
@@ -251,6 +257,10 @@ class ServerNode:
         self._pending: set[str] = set()
         self._claims: dict[str, float] = {}
         self._full_shapes: Optional[dict[str, list]] = None
+        # per-table zero-init flags, known only when THIS server created
+        # the tables from an init_spec (checkpoint loads leave it None —
+        # the loaded arrays are ground truth and flags are moot)
+        self._zero_flags: Optional[dict[str, bool]] = None
         self._loaded = False
         self._stamped_all: set[int] = set()
         self._lock = threading.Lock()
@@ -324,6 +334,9 @@ class ServerNode:
                     self._full_shapes = {
                         k: [int(d) for d in s["shape"]]
                         for k, s in header["specs"].items()}
+                    self._zero_flags = {
+                        k: bool(s.get("zero", False))
+                        for k, s in header["specs"].items()}
                     for k, s in header["specs"].items():
                         lo, hi = shard_range(int(s["shape"][0]), self.rank,
                                              self.world)
@@ -335,9 +348,11 @@ class ServerNode:
                     self._create_group_meta()
                 else:
                     # cross-check FULL shapes (rows AND tails — e.g. two
-                    # difacto confs disagreeing on dim): a divergent
-                    # worker must fail here, not later with misrouted or
-                    # mis-shaped pushes
+                    # difacto confs disagreeing on dim) AND the zero-init
+                    # flag (same shapes but disagreeing on which tables
+                    # are zero-init means an incoherent base mirror): a
+                    # divergent worker must fail here, not later with
+                    # misrouted or mis-shaped pushes
                     want = {k: [int(d) for d in s["shape"]]
                             for k, s in header["specs"].items()}
                     have = self._full_shapes
@@ -345,6 +360,19 @@ class ServerNode:
                         return {"error":
                                 f"init spec mismatch: offered {want} vs "
                                 f"created {have}"}, {}
+                    w_zero = {k: bool(s.get("zero", False))
+                              for k, s in header["specs"].items()}
+                    if (self._zero_flags is not None
+                            and w_zero != self._zero_flags):
+                        return {"error":
+                                f"init spec mismatch: zero flags "
+                                f"{w_zero} vs created "
+                                f"{self._zero_flags}"}, {}
+                    w_drv = header.get("derived") or {}
+                    if self.derived and w_drv and w_drv != self.derived:
+                        return {"error":
+                                f"init spec mismatch: derived tables "
+                                f"{w_drv} vs created {self.derived}"}, {}
                     if not self.derived:
                         # checkpoint loads don't carry derived-table
                         # specs; adopt them from the first worker
@@ -358,7 +386,7 @@ class ServerNode:
                 need = sorted(k for k in self._pending
                               if self._claims.get(k, 0.0) <= now)
                 for k in need:
-                    self._claims[k] = now + 300.0
+                    self._claims[k] = now + INIT_CLAIM_TTL
                 return ({"ok": True, "known": not self._pending,
                          "need": need, "clock": self.clock}, {})
         if op == "init_arrays":
@@ -560,6 +588,7 @@ class ServerNode:
         # overwrite loaded tables)
         self._pending = set()
         self._claims = {}
+        self._zero_flags = None
         for k, v in shard_arrays.items():
             self.tables[k] = np.ascontiguousarray(v, np.float32)
         self._create_group_meta()
@@ -723,7 +752,7 @@ class PSClient:
     def init_from_specs(self, zero_names: set[str],
                         tables: dict[str, np.ndarray],
                         derived: Optional[dict] = None,
-                        timeout: float = 300.0) -> None:
+                        timeout: float = 2 * INIT_CLAIM_TTL) -> None:
         """O(spec) table creation: send {shape, zero} per table; servers
         build zero-init tables locally, CLAIM the rest for the first
         asker, and only the claimant ships them via init_arrays — one
