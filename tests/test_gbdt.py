@@ -121,6 +121,37 @@ def test_pure_leaf_when_no_gain(tmp_path):
     assert lrn.trees["leaf_value"][0][0] != 0.0
 
 
+def test_routing_invariant_validator(tmp_path, monkeypatch):
+    """Pins the sibling-subtraction invariant (gbdt.py _level_fn): the
+    derived right-child histogram of a non-splitting parent is garbage
+    but unreachable. (a) A real fit under WORMHOLE_DEBUG runs the
+    validator on every round and passes; (b) an adversarially perturbed
+    routing — a row claiming to have descended past a non-split node —
+    trips it."""
+    import numpy as np
+
+    from wormhole_tpu.models.gbdt import validate_routing
+
+    monkeypatch.setenv("WORMHOLE_DEBUG", "1")
+    train = _write(tmp_path, "inv.libsvm",
+                   synth_libsvm_text(n_rows=400, n_feat=20, seed=4))
+    cfg = GbdtConfig(train_data=train, max_depth=3, num_round=3, eta=0.5,
+                     max_bin=32)
+    lrn = GbdtLearner(cfg)
+    lrn.fit(verbose=False)  # validator runs per round; must not trip
+
+    # adversarial: node 2 did NOT split, yet a row lands in its child 5
+    tree = {"is_split": np.zeros(15, bool)}
+    tree["is_split"][0] = True
+    tree["is_split"][1] = True
+    node = np.array([3, 4, 5], np.int32)
+    with pytest.raises(AssertionError, match="non-split"):
+        validate_routing(tree, node)
+    # same landing nodes with a fully-split ancestry: fine
+    tree["is_split"][2] = True
+    validate_routing(tree, node)
+
+
 # ---------------------------------------------------------------------------
 # end-to-end convergence
 # ---------------------------------------------------------------------------

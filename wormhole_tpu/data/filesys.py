@@ -9,10 +9,10 @@ doc/common/input.rst:53-115). This module is the TPU build's analog:
 - local paths (and file://) are fully implemented;
 - remote schemes resolve through a registry. gs:// (the TPU-native
   cloud filesystem) auto-binds when `google-cloud-storage` is
-  importable; hdfs:// and s3:// raise a clear error pointing at
-  `register_filesystem`, matching the reference's compile-time
-  USE_HDFS/USE_S3 gating (make/config.mk:24-27) — there the missing
-  backend is a build flag, here it is a runtime plug-in.
+  importable, s3:// when `boto3` is; hdfs:// raises a clear error
+  pointing at `register_filesystem`, matching the reference's
+  compile-time USE_HDFS/USE_S3 gating (make/config.mk:24-27) — there
+  the missing backend is a build flag, here it is a runtime plug-in.
 
 Every consumer (file matching, parsers, CRB reader/writer) goes through
 `open_stream` / `list_dir` / `isfile` / `getsize`, so adding a scheme in
@@ -124,6 +124,95 @@ class GcsFS:
         return int(blob.size)
 
 
+class S3FS:
+    """s3:// over boto3 (optional-import, mirroring GcsFS; reference
+    reads S3 natively via dmlc-core, doc/common/input.rst:53-115).
+    Reads download whole objects into memory buffers; writes upload on
+    close. Credentials resolve through boto3's normal chain (env vars,
+    ~/.aws, instance metadata)."""
+
+    def __init__(self, client=None):
+        if client is None:
+            try:
+                import boto3  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "s3:// paths need the boto3 package. Install it or "
+                    "register_filesystem('s3', <your fs>) with a custom "
+                    "implementation."
+                ) from e
+            client = boto3.client("s3")
+        self._client = client
+
+    @staticmethod
+    def _split(path: str) -> tuple[str, str]:
+        bucket, _, key = path.partition("/")
+        return bucket, key
+
+    def open(self, path: str, mode: str = "rb") -> IO:
+        bucket, key = self._split(path)
+        if "r" in mode:
+            data = self._client.get_object(
+                Bucket=bucket, Key=key)["Body"].read()
+            return io.BytesIO(data) if "b" in mode else io.StringIO(
+                data.decode("utf-8", errors="replace"))
+        client = self._client
+
+        class _Upload(io.BytesIO):
+            def close(self_inner):  # noqa: N805
+                client.put_object(Bucket=bucket, Key=key,
+                                  Body=self_inner.getvalue())
+                super().close()
+
+        return _Upload()
+
+    def _iter_keys(self, bucket: str, prefix: str):
+        token = None
+        while True:
+            kw = {"Bucket": bucket, "Prefix": prefix}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self._client.list_objects_v2(**kw)
+            for obj in resp.get("Contents", []):
+                yield obj
+            token = resp.get("NextContinuationToken")
+            if not token:
+                return
+
+    def list_dir(self, path: str) -> list[str]:
+        bucket, prefix = self._split(path)
+        if prefix and not prefix.endswith("/"):
+            prefix += "/"
+        names = set()
+        for obj in self._iter_keys(bucket, prefix):
+            rest = obj["Key"][len(prefix):]
+            if rest:
+                names.add(rest.split("/", 1)[0])
+        return sorted(names)
+
+    def isfile(self, path: str) -> bool:
+        bucket, key = self._split(path)
+        try:
+            self._client.head_object(Bucket=bucket, Key=key)
+            return True
+        except Exception as e:
+            # only a definite not-found is False; credential/endpoint/
+            # network failures must surface, not read as "no such file"
+            code = str(getattr(e, "response", {}).get(
+                "Error", {}).get("Code", ""))
+            if code in ("404", "NoSuchKey", "NotFound"):
+                return False
+            raise
+
+    def isdir(self, path: str) -> bool:
+        return bool(self.list_dir(path))
+
+    def getsize(self, path: str) -> int:
+        bucket, key = self._split(path)
+        return int(self._client.head_object(
+            Bucket=bucket, Key=key)["ContentLength"])
+
+
 class _UnavailableFS:
     def __init__(self, scheme: str, hint: str):
         self.scheme = scheme
@@ -155,7 +244,9 @@ def get_filesystem(uri: str) -> tuple[object, str]:
             fs = LocalFS()
         elif scheme == "gs":
             fs = GcsFS()  # raises with guidance if the client is absent
-        elif scheme in ("hdfs", "s3", "azure"):
+        elif scheme == "s3":
+            fs = S3FS()  # raises with guidance if boto3 is absent
+        elif scheme in ("hdfs", "azure"):
             fs = _UnavailableFS(
                 scheme, "On TPU, stage data to gs:// or local SSD.")
         else:

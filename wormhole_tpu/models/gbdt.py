@@ -562,6 +562,8 @@ class GbdtLearner:
         for r in range(r0, cfg.num_round):
             tree, node, margin = round_fn(train.binned, train.label,
                                           train.mask, margin)
+            if os.environ.get("WORMHOLE_DEBUG", "") not in ("", "0"):
+                validate_routing(tree, node)
             for k in self.trees:
                 self.trees[k][r] = np.asarray(tree[k])
             msgs = []
@@ -735,6 +737,31 @@ def _binned_at(binned, nf, F: int):
     per-row gather costs ~30ms at the HIGGS shape)."""
     oh = nf[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]
     return jnp.sum(jnp.where(oh, binned.astype(jnp.int32), 0), axis=1)
+
+
+def validate_routing(tree, node) -> None:
+    """Machine check for the sibling-subtraction invariant (the prose at
+    `_level_fn`): the derived right-child histogram of a NON-splitting
+    parent is garbage, which is safe only because routing never descends
+    past a non-split node. This verifies exactly that — every node a row
+    actually landed in must have an all-split ancestor chain — so a
+    future routing edit that lets rows leak into a non-splitting
+    parent's children trips here instead of silently training on garbage
+    histograms. Enabled per round via WORMHOLE_DEBUG=1 (host-side walk
+    over the unique landing nodes: O(T log T), negligible vs a round)."""
+    isp = np.asarray(tree["is_split"])
+    for t in np.unique(np.asarray(node)):
+        path = []
+        while t > 0:
+            t = (t - 1) // 2
+            path.append(t)
+        bad = [p for p in path if not isp[p]]
+        if bad:
+            raise AssertionError(
+                f"sibling-subtraction invariant violated: a row landed "
+                f"in a descendant of non-split node(s) {bad} — routing "
+                f"descended past a non-splitting parent, so derived "
+                f"right-child histograms were trained on garbage")
 
 
 def _empty_trees(cfg: GbdtConfig) -> dict[str, np.ndarray]:

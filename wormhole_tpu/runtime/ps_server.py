@@ -369,7 +369,21 @@ class ServerNode:
                                 f"{w_zero} vs created "
                                 f"{self._zero_flags}"}, {}
                     w_drv = header.get("derived") or {}
-                    if self.derived and w_drv and w_drv != self.derived:
+                    if self._zero_flags is not None:
+                        # tables were created from a worker's spec: the
+                        # creator's derived set is authoritative, so the
+                        # comparison is exact — a worker adding or
+                        # omitting derived tables entirely is just as
+                        # divergent as one redefining them
+                        if w_drv != self.derived:
+                            return {"error":
+                                    f"init spec mismatch: derived "
+                                    f"tables {w_drv} vs created "
+                                    f"{self.derived}"}, {}
+                    elif self.derived and w_drv and w_drv != self.derived:
+                        # checkpoint-loaded: derived may legitimately be
+                        # absent on one side (loads don't carry specs),
+                        # so only a conflicting non-empty pair fails
                         return {"error":
                                 f"init spec mismatch: derived tables "
                                 f"{w_drv} vs created {self.derived}"}, {}
