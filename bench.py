@@ -127,7 +127,9 @@ def bench_linear(num_buckets, minibatch, steps=BENCH_STEPS):
         if lrn.use_pallas and lrn.ensure_compact(idx):
             tc = ck.pack_tile_coo(idx, seg, val, num_buckets,
                                   lrn._compact_cap,
-                                  capacity=cfg.row_capacity)
+                                  capacity=cfg.row_capacity,
+                                  rm_rows=minibatch,
+                                  rm_width=cfg.nnz_per_row)
             batches.append(tuple(lrn._tcoo_args(tc, label, mask)))
             step = lrn._tcoo_steps[0]
         elif lrn.use_pallas:

@@ -292,15 +292,20 @@ def test_fm_pack_row_overflow_drops_from_both_layouts():
     val = np.array(vals, np.float32)
     db = types.SimpleNamespace(seg=seg, idx=idx, val=val)
     pk = lrn._pack_fm(db, train=True)
-    (_, _, _, ts_v, _, vcoo, rm_slot, rm_val) = pk
-    rm_val2 = rm_val.reshape(cfg.minibatch, W)
-    # row 0 keeps exactly W of its 7 interactions...
-    assert np.count_nonzero(rm_val2[0]) == W
-    # ...and the slot COO keeps the SAME multiset of values per row
+    (_, _, wcoo, ts_v, _, vcoo, rm_slot, rm_wval, rm_vval, _) = pk
+    rm_w2 = rm_wval.reshape(cfg.minibatch, W)
+    rm_v2 = rm_vval.reshape(cfg.minibatch, W)
+    # row 0 keeps exactly W of its 7 interactions in every channel...
+    assert np.count_nonzero(rm_w2[0]) == W
+    assert np.count_nonzero(rm_v2[0]) == W
+    # ...and the slot COOs keep the SAME multiset of values per row
     live = vcoo.val != 0
     coo_row0 = np.sort(vcoo.val[live & (vcoo.seg == 0)])
-    np.testing.assert_array_equal(coo_row0, np.sort(rm_val2[0]))
+    np.testing.assert_array_equal(coo_row0, np.sort(rm_v2[0]))
+    livew = wcoo.val != 0
+    wcoo_row0 = np.sort(wcoo.val[livew & (wcoo.seg == 0)])
+    np.testing.assert_array_equal(wcoo_row0, np.sort(rm_w2[0]))
     # untouched rows are intact in both layouts
     for r in range(1, 8):
-        assert np.count_nonzero(rm_val2[r]) == 2
+        assert np.count_nonzero(rm_v2[r]) == 2
         assert np.count_nonzero(vcoo.val[live & (vcoo.seg == r)]) == 2

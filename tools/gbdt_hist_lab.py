@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Histogram-kernel variant lab: times level_hist alternatives at the
+HIGGS bench shape to attack the flat ~14.5 ms/level bin one-hot build
+(PERF.md GBDT wall). Two-point chained timing. Run on TPU.
+
+Usage: python tools/gbdt_hist_lab.py [variant ...]
+"""
+
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS, F, B = 2_000_000, 28, 256
+HBLK = 4096
+NODES_P = 8
+M = 4 * NODES_P
+STEPS = 6
+
+
+def make_inputs(rng):
+    binned = rng.integers(0, B, size=(ROWS, F)).astype(np.uint8)
+    rows_p = -(-ROWS // HBLK) * HBLK
+    binned = np.pad(binned, ((0, rows_p - ROWS), (0, 0)))
+    s = rng.standard_normal((M, rows_p)).astype(np.float32)
+    return jnp.asarray(binned), jnp.asarray(s, jnp.bfloat16)
+
+
+# ---------------------------------------------------------------- variants
+def kern_base(s_ref, binned_ref, out_ref, *, fgroup):
+    """Current production scheme: per-feature full-width compare."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.int32)
+    s = s_ref[:]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bb.shape[0], B), 1)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        a = jnp.concatenate(
+            [(jax.lax.slice_in_dim(bb, f, f + 1, axis=1) == cols)
+             .astype(jnp.bfloat16) for f in range(f0, f1)], axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def kern_nibble(s_ref, binned_ref, out_ref, *, fgroup):
+    """Nibble factorization: 16-wide hi/lo one-hots (1/8 the compares),
+    expanded by static lane repeat/tile, combined with ONE multiply."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.int32)
+    s = s_ref[:]
+    n = bb.shape[0]
+    cols16 = jax.lax.broadcasted_iota(jnp.int32, (n, 16), 1)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        parts = []
+        for f in range(f0, f1):
+            bf = jax.lax.slice_in_dim(bb, f, f + 1, axis=1)
+            oh_hi = ((bf >> 4) == cols16).astype(jnp.bfloat16)
+            oh_lo = ((bf & 15) == cols16).astype(jnp.bfloat16)
+            t_hi = jnp.repeat(oh_hi, 16, axis=1)        # [n, 256]
+            t_lo = jnp.tile(oh_lo, (1, 16))             # [n, 256]
+            parts.append(t_hi * t_lo)
+        a = jnp.concatenate(parts, axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def kern_nibble_cmp(s_ref, binned_ref, out_ref, *, fgroup):
+    """Nibble scheme but the expansion stays in int compare domain:
+    tiled iota compares against pre-shifted values — two 256-wide int
+    compares ANDed, one select. (Control: is compare or select the
+    expensive part?)"""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.int32)
+    s = s_ref[:]
+    n = bb.shape[0]
+    colsB = jax.lax.broadcasted_iota(jnp.int32, (n, B), 1)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        parts = []
+        for f in range(f0, f1):
+            bf = jax.lax.slice_in_dim(bb, f, f + 1, axis=1)
+            hit = ((bf >> 4) == (colsB >> 4)) & ((bf & 15) == (colsB & 15))
+            parts.append(hit.astype(jnp.bfloat16))
+        a = jnp.concatenate(parts, axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def kern_sub_onehot(s_ref, binned_ref, out_ref, *, fgroup):
+    """One-hot as 1 - |clip(bb - cols)| : sub + two min/max + cast —
+    arithmetic instead of compare+select."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.float32)
+    s = s_ref[:]
+    n = bb.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.float32, (n, B), 1)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        parts = []
+        for f in range(f0, f1):
+            bf = jax.lax.slice_in_dim(bb, f, f + 1, axis=1)
+            d = bf - cols
+            a = 1.0 - jnp.minimum(jnp.abs(d), 1.0)
+            parts.append(a.astype(jnp.bfloat16))
+        a = jnp.concatenate(parts, axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+VARIANTS = {
+    "base": kern_base,
+    "nibble": kern_nibble,
+    "nibble_cmp": kern_nibble_cmp,
+    "sub": kern_sub_onehot,
+}
+
+
+def run_variant(name, kern, binned, s, fgroup=7):
+    rows_p = binned.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(rows_p // HBLK,),
+        in_specs=[
+            pl.BlockSpec((M, HBLK), lambda b: (0, b)),
+            pl.BlockSpec((HBLK, F), lambda b: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((M, F * B), lambda b: (0, 0)),
+    )
+    call = pl.pallas_call(
+        partial(kern, fgroup=fgroup),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((M, F * B), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2**20),
+    )
+
+    @jax.jit
+    def step(eps, s):
+        return jnp.sum(call(s + eps.astype(jnp.bfloat16), binned))
+
+    def chain(n):
+        eps = jnp.float32(0.0)
+        for _ in range(n):
+            eps = step(eps * 1e-30, s)
+        float(eps)
+
+    try:
+        chain(2)
+    except Exception as e:
+        print(f"{name:14s} FAILED: {str(e)[:160]}")
+        return None
+    t0 = time.perf_counter()
+    chain(STEPS)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    chain(3 * STEPS)
+    t2 = time.perf_counter() - t0
+    ms = max(t2 - t1, 1e-9) / (2 * STEPS) * 1e3
+    print(f"{name:14s} fgroup={fgroup:2d}  {ms:7.2f} ms/level")
+    return ms
+
+
+def main():
+    rng = np.random.default_rng(0)
+    binned, s = make_inputs(rng)
+    want = sys.argv[1:] or list(VARIANTS)
+    # correctness cross-check on a small slice first
+    small_b, small_s = binned[:HBLK], s[:, :HBLK]
+    ref = None
+    for name in want:
+        kern = VARIANTS[name]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0, grid=(1,),
+            in_specs=[pl.BlockSpec((M, HBLK), lambda b: (0, b)),
+                      pl.BlockSpec((HBLK, F), lambda b: (b, 0))],
+            out_specs=pl.BlockSpec((M, F * B), lambda b: (0, 0)))
+        try:
+            got = pl.pallas_call(
+                partial(kern, fgroup=7), grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((M, F * B), jnp.float32),
+                compiler_params=pltpu.CompilerParams(
+                    vmem_limit_bytes=100 * 2**20),
+            )(small_s, small_b)
+            got = np.asarray(got)
+        except Exception as e:
+            print(f"{name:14s} small-shape FAILED: {str(e)[:160]}")
+            continue
+        if ref is None:
+            ref = got
+            print(f"{name:14s} correctness: REFERENCE")
+        else:
+            ok = np.allclose(got, ref, rtol=0, atol=0)
+            print(f"{name:14s} correctness vs base: "
+                  f"{'EXACT' if ok else 'MISMATCH ' + str(np.abs(got - ref).max())}")
+    for name in want:
+        run_variant(name, VARIANTS[name], binned, s)
+
+
+if __name__ == "__main__":
+    main()

@@ -406,9 +406,6 @@ class DifactoLearner:
                 self._cnt_host[uniq[kept_r]] += live_counts[kept_r]
         adm_nz = (cnt_key >= cfg.threshold)[inv][keep] & live
 
-        wcoo = ck.pack_sorted_coo(slot_nz, seg, val, uw_cap,
-                                  capacity=cfg.row_capacity)
-
         # V domain: localize (bucket % vb) row ids of the kept nonzeros
         vidx = (idx64 % cfg.vb).astype(np.uint64)
         loc_v = localize(vidx)
@@ -419,46 +416,49 @@ class DifactoLearner:
         keepv = vslot_nz < uv_cap
         dropped += int(np.count_nonzero(~keepv & (vval != 0)))
         segv, vvalv, vslotv = seg[keepv], vval[keepv], vslot_nz[keepv]
-        # row-major padded view (minibatch x nnz_per_row) of the live V
-        # nonzeros: the forward's xv/x2 sums become an XLA row gather +
-        # dense reshape-reduce over this layout instead of the radix-
-        # image scatter matmuls (the old fm_pull wall). Slot `uv_cap`
-        # is the appended zero row of the compact table.
+        # row-major padded view (minibatch x nnz_per_row) of the live
+        # nonzeros, laid out over the W-SLOT domain (ck.build_rm): the
+        # forward's xw AND xv/x2 sums become ONE XLA row gather from the
+        # unified compact table U = [V-row | w] (indexed by w slot; see
+        # _build_fm) + a dense reshape-reduce — no radix-image kernel on
+        # the whole forward path. Slot `uw_cap` is the appended zero
+        # row. Three channels ride the layout: the w slot, the w value
+        # (all live nonzeros), and the ADMITTED value (V side — zero
+        # where the count threshold or uv_cap overflow masks the
+        # embedding, matching the reference's unallocated entries).
         W = cfg.nnz_per_row
         mb = cfg.minibatch
-        rm_slot = np.full(mb * W, uv_cap, np.int32)
-        rm_val = np.zeros(mb * W, np.float32)
-        nzv = vvalv != 0
-        # db.seg is CSR-derived and nondecreasing, and boolean masks
-        # preserve order — so the live entries are already row-grouped
-        # (checked with a hard error, not assert: an out-of-order seg
-        # would silently mispack rm_slot/rm_val and corrupt the FM
-        # forward, and -O must not strip the guard; a sort here would be
-        # a wasted O(nnz) pass per batch on the loader threads)
-        seg_nz, slot_nz2, val_nz = segv[nzv], vslotv[nzv], vvalv[nzv]
-        if seg_nz.size and not (np.diff(seg_nz) >= 0).all():
-            raise ValueError(
-                "fm row-major pack: segment ids are not row-grouped "
-                "(CSR order violated) — the input RowBlock's seg must "
-                "be nondecreasing")
-        pos = (np.arange(seg_nz.shape[0])
-               - np.searchsorted(seg_nz, seg_nz, side="left"))
-        fit = pos < W
-        if not fit.all():
-            # a row carries more live nonzeros than nnz_per_row: drop
-            # the overflow from BOTH layouts so pull and push agree
-            nz_pos = np.flatnonzero(nzv)
-            vvalv[nz_pos[~fit]] = 0.0
-            n_over = int(np.count_nonzero(~fit))
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "fm row overflow: dropped %d interactions from rows "
-                "with more than nnz_per_row=%d live V nonzeros — raise "
-                "nnz_per_row to keep them", n_over, W)
-        rm_index = seg_nz[fit] * W + pos[fit]
-        rm_slot[rm_index] = slot_nz2[fit]
-        rm_val[rm_index] = val_nz[fit]
+        rm_slot, (rm_wval, rm_vval), over = ck.build_rm(
+            seg, slot_nz, val, mb, W, uw_cap,
+            extra=(np.where(keepv, vval, 0.0),))
+        if len(over):
+            # overflow beyond nnz_per_row: drop from EVERY layout (rm
+            # forward, wcoo backward, vcoo backward) so pull and push
+            # agree about which nonzeros exist
+            val = val.copy()
+            val[over] = 0.0
+            mask_src = np.ones(len(seg), bool)
+            mask_src[over] = False
+            vvalv[~mask_src[keepv]] = 0.0
+        # per-w-slot V row for the unified table: slot's key -> its V
+        # bucket's compact slot (uv_cap sentinel -> zero V row, covering
+        # alignment holes AND uv_cap-overflowed keys)
+        vslot_w = np.full(uw_cap, uv_cap, np.int32)
+        w_slots_valid = np.flatnonzero(ts_w.uniq < cfg.num_buckets)
+        vkeys = (ts_w.uniq[w_slots_valid].astype(np.int64)
+                 % cfg.vb).astype(np.uint64)
+        li = np.searchsorted(loc_v.uniq_keys, vkeys)
+        li = np.clip(li, 0, max(len(loc_v.uniq_keys) - 1, 0))
+        ok = loc_v.uniq_keys[li] == vkeys
+        vs = np.minimum(ts_v.slot_of_uniq[li], uv_cap).astype(np.int32)
+        vslot_w[w_slots_valid] = np.where(ok, vs, uv_cap)
+        if not train:
+            # eval/predict never scatter: the sorted COO streams (and
+            # their radix sorts) are a train-only cost
+            return (ts_w, wcnts, None, ts_v, None, None,
+                    rm_slot, rm_wval, rm_vval, vslot_w)
+        wcoo = ck.pack_sorted_coo(slot_nz, seg, val, uw_cap,
+                                  capacity=cfg.row_capacity)
         vtouched = np.zeros(uv_cap, np.float32)
         vtouched[np.unique(vslotv[vvalv != 0])] = 1.0
         vcoo = ck.pack_sorted_coo(vslotv, segv, vvalv, uv_cap,
@@ -471,11 +471,20 @@ class DifactoLearner:
                 "fm compaction overflow: dropped %d nonzeros — raise "
                 "the first batch's key diversity (caps %s)",
                 dropped, self._fm_caps)
-        return (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo, rm_slot, rm_val)
+        return (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo,
+                rm_slot, rm_wval, rm_vval, vslot_w)
 
     def _build_fm(self, uw_cap: int, uv_cap: int) -> None:
         cfg = self.cfg
         dt = self._fm_dtype_of()
+        # wire dtype for the XLA gather operands (U, xvd): dt resolves
+        # to None in bf16 mode (the kernels pick bf16 internally), but
+        # astype(None) is a float32 no-op — so name the gather dtype
+        # explicitly. Half-width rows halve the forward/backward gather
+        # bytes; sums still accumulate in f32 (bf16 mode is the
+        # documented throughput opt-in; f32 mode stays exact).
+        wire = dt if dt is not None else (
+            jnp.float32 if ck._use_interpret() else jnp.bfloat16)
         from wormhole_tpu.ops.fused_update import (row_tile_gather,
                                                    scatter_update,
                                                    v_scatter_update)
@@ -487,22 +496,27 @@ class DifactoLearner:
                                  uniq_v, vtm, cfg.dim, dtype=dt)
             return wc, Vc
 
-        def forward(wc, Vc, pk_dev):
-            (widx, wseg, wval, wtmap, wfirst,
-             vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val) = pk_dev
-            xw = ck.coo_spmv(wc, widx, wseg, wval, wtmap, wfirst,
-                             cfg.minibatch, dtype=dt)
-            # row-major forward: one XLA row gather of the compact V
-            # rows + a dense reshape-reduce. Replaces fm_pull's radix-
-            # image scatter matmuls, whose (R, BLK) x (BLK, 2*dim*128)
-            # dots were the DiFacto step's MXU wall (PERF.md). The
-            # gather moves rows at the kernel dtype (half the bytes in
-            # bf16 mode — gathers are bandwidth-bound); products and
-            # sums accumulate in f32.
+        def forward_rm(wc, Vc, rm_slot, rm_wval, rm_vval, vslot_w):
+            # row-major forward over the UNIFIED compact table
+            # U[s] = [V-row of slot s's key | w[s]]: ONE XLA row gather
+            # + a dense reshape-reduce yields xw AND xv/x2 together —
+            # no radix-image kernel anywhere on the forward path (the
+            # former coo_spmv xw was ~7.5 ms of the step, r4 PERF.md).
+            # U's V side is a u_cap-sized row gather (cheap: compact
+            # rows, not nnz), its w side is the tile-gathered compact
+            # w. Rows move at the kernel dtype (half the bytes in bf16
+            # mode); products and sums accumulate in f32.
             Vcz = jnp.concatenate(
-                [Vc.astype(dt), jnp.zeros((1, cfg.dim), dt)], axis=0)
-            V_nnz = jnp.take(Vcz, rm_slot, axis=0)        # [mb*W, dim]
-            p = rm_val[:, None] * V_nnz.astype(jnp.float32)
+                [Vc.astype(wire), jnp.zeros((1, cfg.dim), wire)], axis=0)
+            U = jnp.concatenate(
+                [jnp.take(Vcz, vslot_w, axis=0),
+                 wc.astype(wire)[:, None]], axis=1)   # [uw_cap, dim+1]
+            Uz = jnp.concatenate(
+                [U, jnp.zeros((1, cfg.dim + 1), wire)], axis=0)
+            U_nnz = jnp.take(Uz, rm_slot, axis=0)     # [mb*W, dim+1]
+            xw = (rm_wval * U_nnz[:, cfg.dim].astype(jnp.float32)
+                  ).reshape(cfg.minibatch, -1).sum(1)
+            p = rm_vval[:, None] * U_nnz[:, :cfg.dim].astype(jnp.float32)
             xv = p.reshape(cfg.minibatch, -1, cfg.dim).sum(1)
             x2 = (p * p).reshape(cfg.minibatch, -1, cfg.dim).sum(1)
             margin = xw + 0.5 * jnp.sum(xv * xv - x2, axis=-1)
@@ -512,13 +526,13 @@ class DifactoLearner:
         def train_fm(state, vstate, uniq_w, wtm, wfi, wla, wcnts,
                      widx, wseg, wval, wtmap, wfirst,
                      uniq_v, vtm, vfi, vla, vtouched,
-                     vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val,
+                     vidx, vseg, vval, vtmap, vfirst,
+                     rm_slot, rm_wval, rm_vval, vslot_w,
                      label, mask, rngkey):
             wc, Vc = gather_compact(state, vstate, uniq_w, wtm,
                                     uniq_v, vtm)
-            pk_dev = (widx, wseg, wval, wtmap, wfirst,
-                      vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val)
-            xw, xv, margin = forward(wc, Vc, pk_dev)
+            xw, xv, margin = forward_rm(wc, Vc, rm_slot, rm_wval,
+                                        rm_vval, vslot_w)
             obj, d = linmod._loss_dual(cfg.loss, label, margin)
             d = d * mask
 
@@ -543,14 +557,15 @@ class DifactoLearner:
             # d factors ride ONE row gather from the [mb, dim+1] row
             # layout (padding entries carry val = 0 and vanish); the
             # kernel only re-derives tile V rows and scatters.
-            xvd = jnp.concatenate([xv, d[:, None]], axis=1).astype(dt)
+            xvd = jnp.concatenate([xv, d[:, None]], axis=1).astype(wire)
             G = jnp.take(xvd, vseg, axis=0)
             c = G[:, cfg.dim].astype(jnp.float32) * vval
-            # kernel operands at the kernel dtype: the contrib matmul
-            # runs in dt anyway, so f32 a/b would only double the wire
+            # kernel operands at the wire dtype: the contrib matmul
+            # runs at the kernel dtype anyway, so f32 a/b would only
+            # double the HBM traffic into the scatter kernel
             a = (c[:, None] * G[:, :cfg.dim].astype(jnp.float32)
-                 ).astype(dt)
-            b = (c * vval).astype(dt)
+                 ).astype(wire)
+            b = (c * vval).astype(wire)
             gV = ck.fm_push_contrib(Vc, a, b, vidx, vtmap, vfirst,
                                     dtype=dt)
             if cfg.grad_normalization:
@@ -576,14 +591,15 @@ class DifactoLearner:
             return new_state, new_vstate, prog
 
         @jax.jit
-        def fwd_fm(state, vstate, uniq_w, wtm, widx, wseg, wval, wtmap,
-                   wfirst, uniq_v, vtm, vidx, vseg, vval, vtmap, vfirst,
-                   rm_slot, rm_val, label, mask):
+        def fwd_fm(state, vstate, uniq_w, wtm, uniq_v, vtm,
+                   rm_slot, rm_wval, rm_vval, vslot_w, label, mask):
+            # eval/predict never scatter: only the compact gathers and
+            # the rm channels ride along (the COO streams are a train-
+            # only cost — _pack_fm skips packing them when train=False)
             wc, Vc = gather_compact(state, vstate, uniq_w, wtm,
                                     uniq_v, vtm)
-            pk_dev = (widx, wseg, wval, wtmap, wfirst,
-                      vidx, vseg, vval, vtmap, vfirst, rm_slot, rm_val)
-            _, _, margin = forward(wc, Vc, pk_dev)
+            margin = forward_rm(wc, Vc, rm_slot, rm_wval, rm_vval,
+                                vslot_w)[2]
             obj, _ = linmod._loss_dual(cfg.loss, label, margin)
             return margin, linmod._progress(obj, margin, label, mask)
 
@@ -611,21 +627,22 @@ class DifactoLearner:
         return ("fm", args, blk.size, train, ids)
 
     def _fm_args(self, pk, label, mask, train: bool):
-        (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo, rm_slot, rm_val) = pk
+        (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo,
+         rm_slot, rm_wval, rm_vval, vslot_w) = pk
         j = jnp.asarray
-        wparts = [j(wcoo.idx), j(wcoo.seg), j(wcoo.val), j(wcoo.tmap),
-                  j(wcoo.first)]
-        vparts = [j(vcoo.idx), j(vcoo.seg), j(vcoo.val), j(vcoo.tmap),
-                  j(vcoo.first), j(rm_slot), j(rm_val)]
+        rm_parts = [j(rm_slot), j(rm_wval), j(rm_vval), j(vslot_w)]
         if train:
+            wparts = [j(wcoo.idx), j(wcoo.seg), j(wcoo.val),
+                      j(wcoo.tmap), j(wcoo.first)]
+            vparts = [j(vcoo.idx), j(vcoo.seg), j(vcoo.val),
+                      j(vcoo.tmap), j(vcoo.first)] + rm_parts
             return ([j(ts_w.uniq), j(ts_w.tmap_u), j(ts_w.first_u),
                      j(ts_w.last_u), j(wcnts)] + wparts
                     + [j(ts_v.uniq), j(ts_v.tmap_u), j(ts_v.first_u),
                        j(ts_v.last_u), j(vtouched)] + vparts
                     + [j(label), j(mask)])
-        return ([j(ts_w.uniq), j(ts_w.tmap_u)] + wparts
-                + [j(ts_v.uniq), j(ts_v.tmap_u)] + vparts
-                + [j(label), j(mask)])
+        return ([j(ts_w.uniq), j(ts_w.tmap_u), j(ts_v.uniq),
+                 j(ts_v.tmap_u)] + rm_parts + [j(label), j(mask)])
 
     # -- global-mesh SPMD protocol (apps/_runner._global_train) ------------
     def global_step_protocol(self):

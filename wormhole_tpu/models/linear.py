@@ -287,6 +287,12 @@ class LinearLearner:
 
         @partial(jax.jit, donate_argnums=0)
         def train_step_coo(state, sidx, sseg, sval, tmap, first, label, mask):
+            # NOTE r5: a row-major xw (XLA row gather from a widened w
+            # table) was tried here and measured ~50 ns/row — the dense
+            # table (num_buckets x 8 B, 32 MB at the headline shape) is
+            # too large for the fast-gather regime, unlike the compact
+            # paths (PERF.md "Row-gather regimes"). The radix-image
+            # kernel stays.
             xw = ck.coo_spmv(state["w"], sidx, sseg, sval, tmap, first,
                              cfg.minibatch, dtype=self._coo_dtype)
             obj, d = _loss_dual(cfg.loss, label, xw)
@@ -459,13 +465,20 @@ class LinearLearner:
         cfg = self.cfg
         from wormhole_tpu.ops.fused_update import scatter_update
 
+        def rm_xw_c(wc, rm_slot, rm_val):
+            # same row-major pull as the dense path, over the compact wc
+            wz = jnp.concatenate([wc, jnp.zeros((1,), wc.dtype)])
+            w2c = jnp.stack([wz, wz], axis=1)
+            got = jnp.take(w2c, rm_slot, axis=0)[:, 0]
+            return (rm_val * got).reshape(cfg.minibatch, -1).sum(1)
+
         @partial(jax.jit, donate_argnums=0)
         def train_step_tcoo(state, uniq, tmap_u, first_u, last_u,
-                            sidx, sseg, sval, tmap, first, label, mask):
+                            sidx, sseg, sval, tmap, first,
+                            rm_slot, rm_val, label, mask):
             w2 = state["w"].reshape(-1, ck.LANES)
             wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
-            xw = ck.coo_spmv(wc, sidx, sseg, sval, tmap, first,
-                             cfg.minibatch, dtype=self._coo_dtype)
+            xw = rm_xw_c(wc, rm_slot, rm_val)
             obj, d = _loss_dual(cfg.loss, label, xw)
             d = d * mask
             g = ck.coo_spmv_t(d, sidx, sseg, sval, tmap, first, U,
@@ -482,21 +495,21 @@ class LinearLearner:
 
         @jax.jit
         def eval_step_tcoo(state, uniq, tmap_u, first_u, last_u,
-                           sidx, sseg, sval, tmap, first, label, mask):
+                           sidx, sseg, sval, tmap, first,
+                           rm_slot, rm_val, label, mask):
             w2 = state["w"].reshape(-1, ck.LANES)
             wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
-            xw = ck.coo_spmv(wc, sidx, sseg, sval, tmap, first,
-                             cfg.minibatch, dtype=self._coo_dtype)
+            xw = rm_xw_c(wc, rm_slot, rm_val)
             obj, _ = _loss_dual(cfg.loss, label, xw)
             return _progress(obj, xw, label, mask)
 
         @jax.jit
         def predict_step_tcoo(state, uniq, tmap_u, first_u, last_u,
-                              sidx, sseg, sval, tmap, first):
+                              sidx, sseg, sval, tmap, first,
+                              rm_slot, rm_val):
             w2 = state["w"].reshape(-1, ck.LANES)
             wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
-            return ck.coo_spmv(wc, sidx, sseg, sval, tmap, first,
-                               cfg.minibatch, dtype=self._coo_dtype)
+            return rm_xw_c(wc, rm_slot, rm_val)
 
         self._tcoo_steps = (train_step_tcoo, eval_step_tcoo,
                             predict_step_tcoo)
@@ -544,7 +557,9 @@ class LinearLearner:
         if self.ensure_compact(db.idx):
             tc = ck.pack_tile_coo(db.idx, db.seg, db.val,
                                   self.cfg.num_buckets, self._compact_cap,
-                                  capacity=self.cfg.row_capacity)
+                                  capacity=self.cfg.row_capacity,
+                                  rm_rows=self.cfg.minibatch,
+                                  rm_width=self.cfg.nnz_per_row)
             if tc.dropped_nnz:
                 import logging
 
@@ -668,7 +683,8 @@ class LinearLearner:
         args = [jnp.asarray(tc.uniq), jnp.asarray(tc.tmap_u),
                 jnp.asarray(tc.first_u), jnp.asarray(tc.last_u),
                 jnp.asarray(p.idx), jnp.asarray(p.seg), jnp.asarray(p.val),
-                jnp.asarray(p.tmap), jnp.asarray(p.first)]
+                jnp.asarray(p.tmap), jnp.asarray(p.first),
+                jnp.asarray(tc.rm_slot), jnp.asarray(tc.rm_val)]
         if label is not None:
             args += [jnp.asarray(label), jnp.asarray(mask)]
         return args

@@ -60,6 +60,10 @@ BLK = int(os.environ.get("WORMHOLE_BLK", 4096))  # nnz per grid block
 # throughput-bound, ~1 ns/nnz/channel, not per-block-overhead-bound).
 FM_BLK = int(os.environ.get("WORMHOLE_FM_BLK", 1024))
 _FM_VMEM_LIMIT = int(os.environ.get("WORMHOLE_FM_VMEM", 64 * 2**20))
+# Scoped-VMEM ceiling for the scalar COO / compaction kernels: the
+# compiler's 16 MB default rejects fatter grid blocks (BLK/BLK_U sweeps)
+# long before v5e's 128 MB VMEM is actually at risk.
+_VMEM_LIMIT = int(os.environ.get("WORMHOLE_VMEM", 96 * 2**20))
 
 
 def _use_interpret() -> bool:
@@ -80,6 +84,60 @@ class SortedCOO:
     @property
     def num_blocks(self) -> int:
         return self.tmap.shape[0]
+
+
+def build_rm(seg, slot, val, num_rows: int, width: int,
+             sentinel: int, extra: tuple = ()
+             ) -> tuple[np.ndarray, tuple, np.ndarray]:
+    """Row-major (num_rows x width) padded companion layout of a
+    CSR-ordered COO batch: rm_slot[r*width + j] = slot of row r's j-th
+    live nonzero (sentinel in padding), rm_val likewise (0.0 padding).
+    The pull xw = X w then becomes ONE XLA row gather from the table
+    (widened to >= 8-byte rows) + a dense reshape-reduce — ~2.4 ns/row
+    vs the radix-image kernel's ~3 ns/nnz (PERF.md r5). Fast path: when
+    the batch is exactly width-per-row in row order (the fixed-field
+    Criteo shape), the layout IS the input and no packing runs.
+
+    `extra` carries further per-entry value channels laid out the same
+    way (e.g. difacto's admitted V values next to the w values).
+
+    Returns (rm_slot, rm_vals, overflow_pos): rm_vals is the rm image
+    of val followed by one image per extra channel; overflow_pos are
+    input positions of live entries beyond `width` per row — the CALLER
+    must zero their val in the scatter-side stream(s) too, so pull and
+    push agree about which nonzeros exist (empty on the fast path)."""
+    seg = np.asarray(seg, np.int32)
+    slot = np.asarray(slot)
+    vals = [np.asarray(val, np.float32)] + [np.asarray(x, np.float32)
+                                            for x in extra]
+    empty = np.empty(0, np.int64)
+    n = num_rows * width
+    if len(seg) == n:
+        expect = np.repeat(np.arange(num_rows, dtype=np.int32), width)
+        if np.array_equal(seg, expect):
+            return slot.astype(np.int32, copy=False), tuple(vals), empty
+    rm_slot = np.full(n, sentinel, np.int32)
+    rm_vals = [np.zeros(n, np.float32) for _ in vals]
+    live = vals[0] != 0
+    seg_nz, slot_nz = seg[live], slot[live]
+    if seg_nz.size and not (np.diff(seg_nz) >= 0).all():
+        raise ValueError("build_rm expects row-grouped (CSR order) input")
+    pos = (np.arange(seg_nz.shape[0])
+           - np.searchsorted(seg_nz, seg_nz, side="left"))
+    fit = pos < width
+    over = empty
+    if not fit.all():
+        over = np.flatnonzero(live)[~fit]
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "row-major pack: dropped %d nonzeros from rows with more "
+            "than %d live entries", len(over), width)
+    rm_index = seg_nz[fit] * width + pos[fit]
+    rm_slot[rm_index] = slot_nz[fit]
+    for rv, v in zip(rm_vals, vals):
+        rv[rm_index] = v[live][fit]
+    return rm_slot, tuple(rm_vals), over
 
 
 def packed_size(capacity: int, num_buckets: int,
@@ -265,6 +323,8 @@ def coo_spmv(w, sidx, sseg, sval, tmap, first, num_rows: int, dtype=None):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_rows // LANES, LANES),
                                        jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap, first, w, sidx, sseg, sval)
     return out.reshape(num_rows)
@@ -326,6 +386,8 @@ def coo_spmv_t(d, sidx, sseg, sval, tmap, first, num_buckets: int,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_buckets // LANES, LANES),
                                        jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap, first, d2, sidx, sseg, sval)
     return out.reshape(num_buckets)
@@ -375,6 +437,9 @@ class TileCOO:
     num_uniq: int
     dropped_uniq: int   # unique keys cut on u_cap overflow
     dropped_nnz: int    # their nonzeros, dropped with them
+    # optional row-major companion layout over the compact slot domain
+    rm_slot: np.ndarray | None = None
+    rm_val: np.ndarray | None = None
 
 
 @dataclasses.dataclass
@@ -465,10 +530,14 @@ def assign_tile_slots(uniq, rows_per_tile: int, u_cap: int,
 
 
 def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
-                  capacity: int | None = None) -> TileCOO:
+                  capacity: int | None = None,
+                  rm_rows: int | None = None,
+                  rm_width: int | None = None) -> TileCOO:
     """Localize bucket ids (the reference Localizer's sort+unique+remap,
     localizer.h:98-221) into tile-run-aligned compact slots and pack the
-    COO triples over that domain (host-side, loader threads)."""
+    COO triples over that domain (host-side, loader threads). With
+    rm_rows/rm_width, also emit the row-major companion layout (see
+    build_rm) over the compact slot domain, with u_cap as sentinel."""
     assert u_cap % TILE == 0, f"u_cap must be a multiple of {TILE}"
     assert num_buckets < 2**31, "sentinel id must fit int32"
     from wormhole_tpu.ops.localizer import localize
@@ -484,10 +553,18 @@ def pack_tile_coo(idx, seg, val, num_buckets: int, u_cap: int,
     # count only real (nonzero-valued) dropped entries: padding triples
     # carry val == 0 and losing them loses nothing (ADVICE r2)
     dropped_nnz = int(np.count_nonzero(~keep & (val != 0)))
-    p = pack_sorted_coo(new_slot[keep], seg[keep], val[keep], u_cap,
-                        capacity=capacity)
+    seg_k, val_k, slot_k = seg[keep], val[keep], new_slot[keep]
+    rm_slot = rm_val = None
+    if rm_rows is not None:
+        rm_slot, (rm_val,), over = build_rm(seg_k, slot_k, val_k,
+                                            rm_rows, rm_width, u_cap)
+        if len(over):
+            val_k = val_k.copy()
+            val_k[over] = 0.0  # pull/push must agree on the nnz set
+    p = pack_sorted_coo(slot_k, seg_k, val_k, u_cap, capacity=capacity)
     return TileCOO(ts.uniq, p, ts.tmap_u, ts.first_u, ts.last_u,
-                   ts.num_uniq, ts.dropped_uniq, dropped_nnz)
+                   ts.num_uniq, ts.dropped_uniq, dropped_nnz,
+                   rm_slot, rm_val)
 
 
 def _tile_gather_kernel(tmap_ref, w_ref, uniq_ref, out_ref, *, dtype):
@@ -524,6 +601,8 @@ def tile_gather(table2, uniq, tmap_u, dtype=None):
         partial(_tile_gather_kernel, dtype=dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((u_cap,), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap_u, table2, uniq)
 
@@ -539,40 +618,48 @@ def tile_gather(table2, uniq, tmap_u, dtype=None):
 # shape on v5e.
 
 
-def _fm_push_contrib_kernel(tmap_ref, first_ref, V_ref, a_ref, b_ref,
-                            idx_ref, out_ref, *, dim: int, dtype):
+def _fm_push_contrib_kernel(tmap_ref, first_ref, V_ref, ab_ref,
+                            idx_ref, out_ref, acc_ref, *, dim: int,
+                            dtype):
     # The row-major FM path's scatter: per-nnz contributions arrive
     # PRECOMPUTED (a = c*xv[seg], b = c*val with c = d[seg]*val — both
     # built by cheap XLA row gathers from the [rows, dim] xv, since the
-    # forward keeps xv in row layout), so this kernel only re-derives
-    # the V rows it already streams per tile and scatters
-    #   dV_tile += e_t @ (a - b*vrows)
-    # Replaces _fm_push_kernel's in-kernel one-hot fetch of the
-    # (R, dim*128) radix images — the MXU wall of the old scheme (the
-    # fetch matmul's K was the whole image height; here every matmul is
-    # (BLK, TILE_HI) x (TILE_HI, dim)).
+    # forward keeps xv in row layout). The per-nnz V-row term needs NO
+    # in-kernel fetch at all: with e the (BLK, TILE_HI) one-hot of the
+    # slot ids,
+    #   eᵀ @ (b ⊙ (e @ V_tile)) = (eᵀ @ diag(b) @ e) @ V_tile
+    #                            = diag(eᵀ b) @ V_tile
+    # because eᵀ diag(b) e is diagonal (each nnz hits one slot). So the
+    # kernel scatters [a | b] with ONE eᵀ matmul and applies the b-sums
+    # as a per-row scale of the tile it already streams:
+    #   dV_tile += eᵀ @ [a|b][:, :dim] - (eᵀ @ [a|b][:, dim]) ⊙ V_tile
+    # — halving the one-hot build (the former fetch-side e) and dropping
+    # the (BLK, TILE_HI) x (TILE_HI, dim) vrows matmul entirely.
     blk = pl.program_id(0)
 
     @pl.when(first_ref[blk] == 1)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
     local = idx_ref[:] - tmap_ref[blk] * TILE_HI
-    e = _onehot(local, TILE_HI, dtype)
-    vrows = jax.lax.dot_general(
-        e, V_ref[:].astype(dtype),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_prec(dtype),
-    )                                             # [BLK, dim]
-    contrib = a_ref[:] - b_ref[:][:, None] * vrows
     e_t = _onehot_t(local, TILE_HI, dtype)
-    out_ref[:] += jax.lax.dot_general(
-        e_t, contrib.astype(dtype),
+    acc_ref[:] += jax.lax.dot_general(
+        e_t, ab_ref[:].astype(dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=_prec(dtype),
     )
+
+    # last block of this tile's run: the next block is another tile's
+    # first (or the grid ends) — apply the diagonal b-sum term and flush
+    nblk = pl.num_programs(0)
+    is_last = jnp.where(blk == nblk - 1, 1,
+                        first_ref[jnp.minimum(blk + 1, nblk - 1)])
+
+    @pl.when(is_last == 1)
+    def _():
+        acc = acc_ref[:]
+        out_ref[:] = acc[:, :dim] - acc[:, dim:dim + 1] * V_ref[:]
 
 
 def fm_push_contrib(V, a, b, sidx, tmap, first, dtype=None):
@@ -586,17 +673,18 @@ def fm_push_contrib(V, a, b, sidx, tmap, first, dtype=None):
     assert rows % TILE_HI == 0
     nblk = tmap.shape[0]
     blk = sidx.shape[0] // nblk
+    ab = jnp.concatenate([a, b[:, None]], axis=1)    # [P, dim+1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(nblk,),
         in_specs=[
             pl.BlockSpec((TILE_HI, dim), lambda b_, tmap, first: (tmap[b_], 0)),
-            pl.BlockSpec((blk, dim), lambda b_, *_: (b_, 0)),
-            pl.BlockSpec((blk,), lambda b_, *_: (b_,)),
+            pl.BlockSpec((blk, dim + 1), lambda b_, *_: (b_, 0)),
             pl.BlockSpec((blk,), lambda b_, *_: (b_,)),
         ],
         out_specs=pl.BlockSpec((TILE_HI, dim),
                                lambda b_, tmap, first: (tmap[b_], 0)),
+        scratch_shapes=[pltpu.VMEM((TILE_HI, dim + 1), jnp.float32)],
     )
     return pl.pallas_call(
         partial(_fm_push_contrib_kernel, dim=dim, dtype=dtype),
@@ -605,7 +693,7 @@ def fm_push_contrib(V, a, b, sidx, tmap, first, dtype=None):
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_FM_VMEM_LIMIT),
         interpret=_use_interpret(),
-    )(tmap, first, V, a, b, sidx)
+    )(tmap, first, V, ab, sidx)
 
 
 # ---------------------------------------------------------- mesh sharding

@@ -56,6 +56,11 @@ class Perf:
         finally:
             self.add(op, time.perf_counter() - t0)
 
+    def snapshot(self) -> tuple[dict, dict]:
+        """Consistent (sums, counts) copies taken under the lock."""
+        with self._lock:
+            return dict(self._sum), dict(self._cnt)
+
     def mean_ms(self, op: str) -> float:
         with self._lock:
             c = self._cnt.get(op, 0)
