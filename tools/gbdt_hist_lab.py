@@ -113,6 +113,105 @@ def kern_nibble_cmp(s_ref, binned_ref, out_ref, *, fgroup):
             preferred_element_type=jnp.float32)
 
 
+def kern_where(s_ref, binned_ref, out_ref, *, fgroup):
+    """Same compare, but the 0/1 production is an explicit where with
+    bf16 constants — probes whether astype(i1 -> bf16) lowers as a
+    multi-pass cast chain."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.int32)
+    s = s_ref[:]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bb.shape[0], B), 1)
+    one = jnp.bfloat16(1)
+    zero = jnp.bfloat16(0)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        a = jnp.concatenate(
+            [jnp.where(jax.lax.slice_in_dim(bb, f, f + 1, axis=1) == cols,
+                       one, zero) for f in range(f0, f1)], axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def kern_via_f32(s_ref, binned_ref, out_ref, *, fgroup):
+    """Compare then i1 -> f32 -> bf16 explicitly (a different cast
+    route than astype(bf16))."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.int32)
+    s = s_ref[:]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bb.shape[0], B), 1)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        a = jnp.concatenate(
+            [(jax.lax.slice_in_dim(bb, f, f + 1, axis=1) == cols)
+             .astype(jnp.float32) for f in range(f0, f1)], axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a.astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def kern_i16(s_ref, binned_ref, out_ref, *, fgroup):
+    """int16 compares: i16 vregs pack 2 values per 32-bit lane — if
+    Mosaic emits packed compares/selects this halves the VPU passes."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.int16)
+    s = s_ref[:]
+    cols = jax.lax.broadcasted_iota(jnp.int16, (bb.shape[0], B), 1)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        a = jnp.concatenate(
+            [(jax.lax.slice_in_dim(bb, f, f + 1, axis=1) == cols)
+             .astype(jnp.bfloat16) for f in range(f0, f1)], axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def kern_nibble_f32(s_ref, binned_ref, out_ref, *, fgroup):
+    """Nibble factorization with the repeat/tile expansion in f32
+    (bf16 lane-shuffle lowering may be the nibble variant's failure)."""
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bb = binned_ref[:].astype(jnp.int32)
+    s = s_ref[:]
+    n = bb.shape[0]
+    cols16 = jax.lax.broadcasted_iota(jnp.int32, (n, 16), 1)
+    for f0 in range(0, F, fgroup):
+        f1 = min(f0 + fgroup, F)
+        parts = []
+        for f in range(f0, f1):
+            bf = jax.lax.slice_in_dim(bb, f, f + 1, axis=1)
+            oh_hi = ((bf >> 4) == cols16).astype(jnp.float32)
+            oh_lo = ((bf & 15) == cols16).astype(jnp.float32)
+            t_hi = jnp.repeat(oh_hi, 16, axis=1)
+            t_lo = jnp.tile(oh_lo, (1, 16))
+            parts.append((t_hi * t_lo).astype(jnp.bfloat16))
+        a = jnp.concatenate(parts, axis=1)
+        out_ref[:, f0 * B:f1 * B] += jax.lax.dot_general(
+            s, a, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
 def kern_sub_onehot(s_ref, binned_ref, out_ref, *, fgroup):
     """One-hot as 1 - |clip(bb - cols)| : sub + two min/max + cast —
     arithmetic instead of compare+select."""
@@ -122,7 +221,7 @@ def kern_sub_onehot(s_ref, binned_ref, out_ref, *, fgroup):
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bb = binned_ref[:].astype(jnp.float32)
+    bb = binned_ref[:].astype(jnp.int32).astype(jnp.float32)
     s = s_ref[:]
     n = bb.shape[0]
     cols = jax.lax.broadcasted_iota(jnp.float32, (n, B), 1)
@@ -143,7 +242,11 @@ def kern_sub_onehot(s_ref, binned_ref, out_ref, *, fgroup):
 VARIANTS = {
     "base": kern_base,
     "nibble": kern_nibble,
+    "nibble_f32": kern_nibble_f32,
     "nibble_cmp": kern_nibble_cmp,
+    "i16": kern_i16,
+    "where": kern_where,
+    "via_f32": kern_via_f32,
     "sub": kern_sub_onehot,
 }
 
@@ -194,6 +297,7 @@ def run_variant(name, kern, binned, s, fgroup=7):
 
 
 def main():
+    global HBLK
     rng = np.random.default_rng(0)
     binned, s = make_inputs(rng)
     want = sys.argv[1:] or list(VARIANTS)
@@ -201,6 +305,8 @@ def main():
     small_b, small_s = binned[:HBLK], s[:, :HBLK]
     ref = None
     for name in want:
+        if name not in VARIANTS:
+            continue
         kern = VARIANTS[name]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=0, grid=(1,),
@@ -226,7 +332,17 @@ def main():
             print(f"{name:14s} correctness vs base: "
                   f"{'EXACT' if ok else 'MISMATCH ' + str(np.abs(got - ref).max())}")
     for name in want:
+        if name.startswith("sweep"):
+            continue
         run_variant(name, VARIANTS[name], binned, s)
+    if "sweep" in want:
+        for hblk in (4096, 8192):
+            HBLK = hblk
+            rows_p = (binned.shape[0] // HBLK) * HBLK  # trim to multiple
+            b2, s2 = binned[:rows_p], s[:, :rows_p]
+            for fg in (4, 7, 14, 28):
+                print(f"HBLK={hblk}", end=" ")
+                run_variant("via_f32", kern_via_f32, b2, s2, fgroup=fg)
 
 
 if __name__ == "__main__":

@@ -69,7 +69,8 @@ def main():
     Vc = row_tile_gather(V2, uniq_v, vtm, dim, dtype=dt)
     d = jnp.ones((MB,), jnp.float32) * 0.1
     xv = jnp.ones((MB, dim), jnp.float32) * 0.05
-    xvd = jnp.concatenate([xv, d[:, None]], axis=1)  # f32: real path astype(None)
+    xvd = jnp.concatenate([xv, d[:, None]],
+                          axis=1).astype(dt)  # bf16 wire (r5)
     G = jnp.take(xvd, vseg, axis=0)
     c = G[:, dim].astype(jnp.float32) * vval
     a = c[:, None] * G[:, :dim]

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Replay one PS sync in-process at the bench operating point (2^26
+buckets, FTRL z/n push + derived-w pull) to attribute the distributed
+bench's dist-vs-single gap: this measures the DESIGN cost of a sync
+(touched-gather, wire encode/decode, server merge, versioned pull),
+while the multi-process bench additionally pays 3-processes-on-1-core
+scheduler timesharing. See PERF.md "PS plane".
+
+Usage: python tools/ps_sync_micro.py [nnz_per_sync]
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from wormhole_tpu.runtime.ps_server import PSClient, ServerNode, SyncedStore
+
+NB = 1 << 26
+NNZ = int(sys.argv[1]) if len(sys.argv) > 1 else 975_000
+
+
+class _Store:
+    """Host-numpy stand-in for the learner's KV store."""
+
+    def __init__(self):
+        self.tables = {k: np.zeros(NB, np.float32) for k in ("w", "z", "n")}
+
+    def to_numpy(self):
+        return dict(self.tables)
+
+    def from_numpy(self, arrays):
+        self.tables.update(arrays)
+
+    def gather_rows(self, k, idx):
+        return self.tables[k][idx]
+
+    def scatter_rows(self, k, idx, vals):
+        self.tables[k][idx] = vals
+
+    def zero_init_names(self):
+        return set(self.tables)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # zipf draws like the bench's synthetic Criteo batch
+    touched = np.unique(rng.zipf(1.2, size=NNZ).astype(np.int64) % NB)
+    print(f"touched rows/sync: {len(touched)}")
+    node = ServerNode(0, 1)
+    node.serve()
+    client = PSClient([node.uri])
+    st = _Store()
+    derived = {"w": {"kind": "ftrl_prox", "lr_eta": 0.1, "lr_beta": 1.0,
+                     "lambda_l1": 1.0, "lambda_l2": 0.0}}
+    ss = SyncedStore(st, client, max_delay=1, derived=derived,
+                     touched_fn=lambda: {k: touched
+                                         for k in ("w", "z", "n")})
+    ss.init()
+    for it in range(4):
+        st.tables["z"][touched] += 0.1
+        st.tables["n"][touched] += 0.01
+        t0 = time.perf_counter()
+        got = ss._touched_groups()
+        t1 = time.perf_counter()
+        client.push_sparse(*got)
+        t2 = time.perf_counter()
+        ss._apply_pull()
+        t3 = time.perf_counter()
+        print(f"sync {it}: touched-gather {1e3 * (t1 - t0):5.0f} ms   "
+              f"push {1e3 * (t2 - t1):5.0f} ms   "
+              f"pull {1e3 * (t3 - t2):5.0f} ms   "
+              f"total {1e3 * (t3 - t0):5.0f} ms")
+    client.close()
+    node.stop()
+
+
+if __name__ == "__main__":
+    main()

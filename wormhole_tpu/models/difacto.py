@@ -452,6 +452,13 @@ class DifactoLearner:
         ok = loc_v.uniq_keys[li] == vkeys
         vs = np.minimum(ts_v.slot_of_uniq[li], uv_cap).astype(np.int32)
         vslot_w[w_slots_valid] = np.where(ok, vs, uv_cap)
+        if dropped:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fm compaction overflow: dropped %d nonzeros — raise "
+                "the first batch's key diversity (caps %s)",
+                dropped, self._fm_caps)
         if not train:
             # eval/predict never scatter: the sorted COO streams (and
             # their radix sorts) are a train-only cost
@@ -464,13 +471,6 @@ class DifactoLearner:
         vcoo = ck.pack_sorted_coo(vslotv, segv, vvalv, uv_cap,
                                   capacity=cfg.row_capacity,
                                   tile=ck.TILE_HI, blk=ck.FM_BLK)
-        if dropped:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "fm compaction overflow: dropped %d nonzeros — raise "
-                "the first batch's key diversity (caps %s)",
-                dropped, self._fm_caps)
         return (ts_w, wcnts, wcoo, ts_v, vtouched, vcoo,
                 rm_slot, rm_wval, rm_vval, vslot_w)
 

@@ -251,9 +251,14 @@ def _lane_pick(rows, lane_onehot):
 def _onehot(ids, width: int, dtype):
     """(BLK, width) one-hot of int vector ids — the E/C matrices the
     MXU uses to play gather/scatter. One-hots are exact in any float
-    dtype; bf16 halves the MXU cost of the matmuls they feed."""
+    dtype; bf16 halves the MXU cost of the matmuls they feed. The cast
+    ROUTE matters ~2x on the VPU: i1 -> f32 (native select) then one
+    f32 -> bf16 pack, instead of a direct i1 -> bf16 astype (Mosaic
+    lowers that as a multi-pass cast chain — measured on the GBDT
+    histogram build, tools/gbdt_hist_lab.py r5)."""
     cols = jax.lax.broadcasted_iota(jnp.int32, (ids.shape[0], width), 1)
-    return (ids[:, None] == cols).astype(dtype)
+    eq = (ids[:, None] == cols).astype(jnp.float32)
+    return eq if dtype == jnp.float32 else eq.astype(dtype)
 
 
 def _onehot_t(ids, width: int, dtype):
@@ -262,9 +267,11 @@ def _onehot_t(ids, width: int, dtype):
     the nnz axis; feeding dot_general an untransposed one-hot there
     makes Mosaic materialize a (BLK, width) transpose on the VPU, which
     measured ~1.5 ns/nnz — building the operand pre-transposed cuts the
-    scatter side from ~2.4 to ~1.3 ns/nnz."""
+    scatter side from ~2.4 to ~1.3 ns/nnz. Same f32-route cast as
+    _onehot."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (width, ids.shape[0]), 0)
-    return (ids[None, :] == rows).astype(dtype)
+    eq = (ids[None, :] == rows).astype(jnp.float32)
+    return eq if dtype == jnp.float32 else eq.astype(dtype)
 
 
 # --------------------------------------------------------------------- pull

@@ -250,6 +250,19 @@ class ServerNode:
         # rows dirty since the last derived recompute, per group:
         # list of shard-local index arrays, or "all" after a dense push
         self._dirty: dict[int, object] = {}
+        # push log for O(pushed) versioned pulls: per group a list of
+        # (clock, idx) from sparse pushes, and the clock BEFORE the
+        # oldest logged entry. A pull with since >= _log_start[g] takes
+        # the union of logged rows newer than `since` instead of the
+        # O(shard rows) version-array scan — at the 2^26 operating point
+        # that scan walks 64M entries per group per sync and was the
+        # dominant term of the measured PS-plane overhead (PERF.md r5).
+        # Dense merges / checkpoint stamps reset the log (the scan
+        # fallback stays correct); the log is capped so memory stays
+        # O(recent pushes).
+        self._pushlog: dict[int, list] = {}
+        self._log_start: dict[int, int] = {}
+        self._log_elems: dict[int, int] = {}
         # spec-init bookkeeping: non-zero-init tables awaiting their
         # arrays, per-table upload claims (name -> deadline), the full
         # table shapes for the divergent-conf cross-check, and the
@@ -299,6 +312,7 @@ class ServerNode:
         for g in {r for r in self.full_rows.values()}:
             self._ver[g] = np.zeros(self._shard_rows(g), np.uint32)
             self._dirty[g] = []
+            self._reset_pushlog(g)
 
     # -- ops ----------------------------------------------------------------
     def _dispatch(self, header: dict, arrays: dict) -> tuple[dict, dict]:
@@ -437,8 +451,14 @@ class ServerNode:
                     return {"ok": True, "clock": self.clock}, out
                 self._recompute_derived()
                 for g, ver in self._ver.items():
-                    idx = np.flatnonzero(ver > since)
-                    out[_idx_name(g)] = idx.astype(np.int64)
+                    if since >= self._log_start.get(g, self.clock):
+                        parts = [i for c, i in self._pushlog[g]
+                                 if c > since]
+                        idx = (np.unique(np.concatenate(parts))
+                               if parts else np.empty(0, np.int64))
+                    else:
+                        idx = np.flatnonzero(ver > since).astype(np.int64)
+                    out[_idx_name(g)] = idx
                     for k, rows in self.full_rows.items():
                         if rows == g:
                             out[k] = self.tables[k][idx]
@@ -481,6 +501,7 @@ class ServerNode:
                     self._ver[g][idx] = self.clock
                     if self._dirty.get(g) != "all":
                         self._dirty.setdefault(g, []).append(idx)
+                    self._log_push(g, idx)
                 # any dense-merged group is wholly dirty — including in a
                 # MIXED frame where other groups carried idx arrays;
                 # stamping per merged group (not only when NO idx exists)
@@ -489,6 +510,7 @@ class ServerNode:
                 for g in dense_groups:
                     self._ver[g][:] = self.clock
                     self._dirty[g] = "all"
+                    self._reset_pushlog(g)
                 return {"ok": True, "clock": self.clock}, {}
         if op == "save":
             path = self._save(header["base"], header.get("iter"))
@@ -522,6 +544,28 @@ class ServerNode:
         if op == "shutdown":
             return {"ok": True}, {}
         return {"error": f"unknown op {op!r}"}, {}
+
+    # cap: at most this many logged row-indices per group; beyond it the
+    # oldest entries fall off and pulls older than the floor use the scan
+    _LOG_ELEM_CAP = 1 << 23
+
+    def _log_push(self, g: int, idx) -> None:
+        """Record a sparse push for O(pushed) pulls (lock held)."""
+        arr = np.asarray(idx, np.int64)
+        self._pushlog[g].append((self.clock, arr))
+        self._log_elems[g] += arr.size
+        while (self._log_elems[g] > self._LOG_ELEM_CAP
+               and len(self._pushlog[g]) > 1):
+            c, old = self._pushlog[g].pop(0)
+            self._log_elems[g] -= old.size
+            self._log_start[g] = c
+
+    def _reset_pushlog(self, g: int) -> None:
+        """Version stamps changed outside push (load/spec stamp): the
+        log no longer covers history before this clock (lock held)."""
+        self._pushlog[g] = []
+        self._log_start[g] = self.clock
+        self._log_elems[g] = 0
 
     def _recompute_derived(self) -> None:
         """Recompute derived tables from their additive sources over the
@@ -618,6 +662,9 @@ class ServerNode:
                 nz = t_nz if nz is None else (nz | t_nz)
             if nz is not None:
                 ver[nz] = self.clock
+            # stamps bypassed the push log: pulls older than this clock
+            # must take the scan path
+            self._reset_pushlog(g)
 
     def _stamp_nonspec_groups(self, specs: dict) -> None:
         """After a checkpoint load, groups holding non-zero-init tables
@@ -634,6 +681,7 @@ class ServerNode:
             if g is None or g in self._stamped_all:
                 continue
             self._ver[g][:] = self.clock
+            self._reset_pushlog(g)
             self._stamped_all.add(g)
 
     def _save(self, base: str, it: Optional[int]) -> str:
