@@ -199,7 +199,7 @@ def test_push_log_capped_fallback_matches_scan(group):
     client.init({"w": np.zeros(n, np.float32)})
     # shrink the cap so the second push evicts the first from the log
     for node in nodes:
-        node._LOG_ELEM_CAP = 4
+        node._LOG_ELEM_CAP = 2
     idx1 = np.array([3, 9], np.int64)
     client.push_sparse({n: idx1}, {"w": np.ones(2, np.float32)})
     c_mid = [node.clock for node in nodes]

@@ -405,8 +405,22 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
         # hold the job open.
         drain_deadline = time.monotonic() + max(120.0,
                                                 sched.node_timeout * 4)
+        # fast path for a mis-launched job (predict with a wrong -n is
+        # the classic): if NO worker has ever registered after a
+        # startup-sized grace (generous enough for slow JAX/TPU init —
+        # node_timeout only bounds ping gaps of REGISTERED workers),
+        # none is coming — exit LOUDLY instead of holding the scheduler
+        # for the full drain bound
+        none_deadline = time.monotonic() + max(60.0,
+                                               sched.node_timeout * 2)
         while (not sched.workers_drained(env.num_workers)
                and time.monotonic() < drain_deadline):
+            if (sched.workers_ever_seen() == 0
+                    and time.monotonic() >= none_deadline):
+                print("[scheduler] WARNING: no worker ever registered; "
+                      "abandoning shutdown drain (mis-launched job? "
+                      "check -n and the worker logs)", flush=True)
+                break
             time.sleep(0.2)
         if ps is not None:
             ps.shutdown()

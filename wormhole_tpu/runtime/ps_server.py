@@ -545,16 +545,22 @@ class ServerNode:
             return {"ok": True}, {}
         return {"error": f"unknown op {op!r}"}, {}
 
-    # cap: at most this many logged row-indices per group; beyond it the
-    # oldest entries fall off and pulls older than the floor use the scan
+    # caps: logged row-indices AND entry count per group; beyond either
+    # the oldest entries fall off and pulls older than the floor use the
+    # scan (the entry cap stops tiny-push streams from growing the log
+    # into an O(total pushes) python walk per pull)
     _LOG_ELEM_CAP = 1 << 23
+    _LOG_ENTRY_CAP = 4096
 
     def _log_push(self, g: int, idx) -> None:
         """Record a sparse push for O(pushed) pulls (lock held)."""
         arr = np.asarray(idx, np.int64)
+        if arr.size == 0:
+            return  # nothing dirtied in this shard's range
         self._pushlog[g].append((self.clock, arr))
         self._log_elems[g] += arr.size
-        while (self._log_elems[g] > self._LOG_ELEM_CAP
+        while ((self._log_elems[g] > self._LOG_ELEM_CAP
+                or len(self._pushlog[g]) > self._LOG_ENTRY_CAP)
                and len(self._pushlog[g]) > 1):
             c, old = self._pushlog[g].pop(0)
             self._log_elems[g] -= old.size
