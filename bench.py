@@ -130,7 +130,8 @@ def bench_linear(num_buckets, minibatch, steps=BENCH_STEPS):
                                   capacity=cfg.row_capacity,
                                   rm_rows=minibatch,
                                   rm_width=cfg.nnz_per_row)
-            batches.append(tuple(lrn._tcoo_args(tc, label, mask)))
+            batches.append(tuple(lrn._tcoo_args(tc, label, mask,
+                                                train=True)))
             step = lrn._tcoo_steps[0]
         elif lrn.use_pallas:
             p = ck.pack_sorted_coo(idx, seg, val, num_buckets,

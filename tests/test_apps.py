@@ -901,3 +901,93 @@ max_data_pass = 0
         assert got.shape == single.shape, (got.shape, single.shape)
         np.testing.assert_allclose(np.sort(got), np.sort(single),
                                    atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_server_death_recovers_with_respawn(train_files, tmp_path):
+    """Chaos end-to-end: WH_FAULT_SPEC hard-kills the ps server mid-push
+    (os._exit, SIGKILL-shaped); with --max-server-restarts the launcher
+    respawns it with its snapshot restored and the workers ride the
+    death out through PSClient's fenced retry + journal replay. The job
+    must exit 0 and land the same validation logloss as an unfaulted
+    single-process run — recovery that silently loses or doubles deltas
+    would show up here as drift."""
+    import re
+
+    conf = tmp_path / "chaos.conf"
+    conf.write_text(f"""
+train_data = "{train_files}/train-.*"
+val_data = "{train_files}/val.libsvm"
+model_out = {tmp_path}/cmodel
+algo = ftrl
+lambda_l1 = 1
+minibatch = 256
+num_buckets = 16384
+max_data_pass = 8
+max_delay = 1
+server_snapshot_sec = 0.5
+""")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               WH_FAULT_SPEC="server:0:kill@push:10")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "2", "-s", "1", "--node-timeout", "10",
+         "--max-server-restarts", "1", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.linear", str(conf)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # the fault fired, the supervisor respawned, the workers retried
+    assert "killing itself" in r.stdout, r.stdout
+    assert "respawning with restore epoch 1" in r.stdout, r.stdout
+    assert "[ps-retry]" in r.stdout, r.stdout
+    assert os.path.exists(f"{tmp_path}/cmodel.npz"), r.stdout
+    m = re.search(r"final val: logloss=([0-9.]+)", r.stdout)
+    assert m, r.stdout
+    chaos_logloss = float(m.group(1))
+
+    from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+    from wormhole_tpu.solver.minibatch_solver import MinibatchSolver
+
+    cfg = LinearConfig(
+        train_data=f"{train_files}/train-.*",
+        val_data=f"{train_files}/val.libsvm",
+        algo="ftrl", lambda_l1=1.0, minibatch=256, num_buckets=16384,
+        max_data_pass=8)
+    res = MinibatchSolver(LinearLearner(cfg), cfg, verbose=False).run()
+    single_logloss = res["val"].mean("logloss")
+    assert abs(chaos_logloss - single_logloss) < 0.05, (
+        chaos_logloss, single_logloss, r.stdout)
+
+
+@pytest.mark.slow
+def test_server_respawn_cap_exhaustion_fails_loudly(train_files, tmp_path):
+    """A server that dies on EVERY incarnation (':always' re-arms the
+    kill after each respawn) must exhaust max_server_restarts and fail
+    the job with a terminal error naming the cap — a crash-looping
+    server must not keep a doomed job alive forever."""
+    conf = tmp_path / "loop.conf"
+    conf.write_text(f"""
+train_data = "{train_files}/train-.*"
+val_data = "{train_files}/val.libsvm"
+algo = ftrl
+lambda_l1 = 1
+minibatch = 256
+num_buckets = 16384
+max_data_pass = 8
+max_delay = 1
+server_snapshot_sec = 0.5
+""")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               WH_FAULT_SPEC="server:0:kill@push:4:always",
+               WH_PS_RETRY_SEC="15")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "1", "-s", "1", "--node-timeout", "5",
+         "--max-server-restarts", "1", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.linear", str(conf)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode != 0, out
+    assert "max_server_restarts=1 is exhausted" in out, out
+    # the worker's retry budget expired with the resume guidance intact
+    assert "did not come back" in out or "all workers lost" in out, out

@@ -507,3 +507,277 @@ def test_init_spec_shape_mismatch_fails_loudly(group):
     with pytest.raises(RuntimeError, match="spec mismatch"):
         c2.init_from_specs({"w"}, {"w": np.zeros(32, np.float32)})
     c2.close()
+
+
+# ------------------------------------------------------- fault tolerance
+# Server death, fenced retry, snapshot restore (runtime/faults.py,
+# PSClient retry machinery, ServerNode.snapshot/restore_snapshot). The
+# multi-process end-to-end versions live in test_apps.py (marked slow);
+# these cover every protocol piece in-process.
+
+from wormhole_tpu.runtime import faults  # noqa: E402
+
+
+@pytest.fixture
+def solo():
+    """A one-server group plus a plain (no-retry) client."""
+    node = ServerNode(0, 1)
+    node.serve()
+    client = PSClient([node.uri])
+    yield node, client
+    client.close()
+    node.stop()
+
+
+def test_duplicate_push_applied_once(solo):
+    """The seq fence: a replayed push (same sender+seq) must be ACKed
+    without re-applying the delta or advancing the clock — the property
+    that makes the client's blind journal replay safe."""
+    node, client = solo
+    client.init({"w": np.zeros(8, np.float32)})
+    d = np.ones(8, np.float32)
+    hdr = {"op": "push", "sender": "worker-0", "seq": 1}
+    h1, _ = client._rpc(0, dict(hdr), {"w": d})
+    assert not h1.get("dup")
+    h2, _ = client._rpc(0, dict(hdr), {"w": d})  # the retry/replay
+    assert h2.get("dup") is True
+    assert h2["clock"] == h1["clock"]  # no clock advance on dup
+    np.testing.assert_array_equal(client.pull()["w"], d)  # applied ONCE
+    # the next fresh seq goes through normally
+    client._rpc(0, {"op": "push", "sender": "worker-0", "seq": 2}, {"w": d})
+    np.testing.assert_array_equal(client.pull()["w"], 2 * d)
+    # hello reports the fence so a reconnecting client knows where its
+    # journal replay starts
+    h, _ = client._rpc(0, {"op": "hello", "sender": "worker-0"})
+    assert h["last_seq"] == 2
+    h, _ = client._rpc(0, {"op": "hello", "sender": "worker-9"})
+    assert h["last_seq"] == 0
+
+
+def test_client_stamps_seqs_when_named(solo):
+    """A sender-named client fences its own pushes; the default
+    anonymous client sends exactly the old wire (no seq keys)."""
+    node, client = solo
+    client.init({"w": np.zeros(4, np.float32)})
+    named = PSClient([node.uri], sender="worker-3", retry_deadline=5.0)
+    named.push({"w": np.ones(4, np.float32)})
+    named.push({"w": np.ones(4, np.float32)})
+    h, _ = named._rpc(0, {"op": "hello", "sender": "worker-3"})
+    assert h["last_seq"] == 2
+    assert len(named._journal[0]) == 2  # journaled for replay
+    named.close()
+    # the anonymous client never touched the fence
+    client.push({"w": np.ones(4, np.float32)})
+    assert client._journal[0].maxlen and len(client._journal[0]) == 0
+
+
+def test_snapshot_restore_roundtrip(tmp_path):
+    """A respawned server restoring its snapshot resumes MID-training:
+    tables, clock, seq fence, and derived specs all survive, and the
+    restored rows are version-stamped so a versioned pull still sees
+    them."""
+    base = str(tmp_path / "srv")
+    node = ServerNode(0, 1)
+    node.serve()
+    client = PSClient([node.uri])
+    try:
+        spec = {"w": {"kind": "ftrl_prox", "lr_eta": 0.5, "lr_beta": 1.0,
+                      "lambda_l1": 1.0, "lambda_l2": 0.0}}
+        zeros = {k: np.zeros(16, np.float32) for k in ("w", "z", "n")}
+        client.init(zeros, derived=spec)
+        idx = np.array([2, 9], np.int64)
+        client.push_sparse(
+            {16: idx},
+            {"w": np.zeros(2, np.float32),
+             "z": np.full(2, 1.8, np.float32),
+             "n": np.full(2, 0.25, np.float32)})
+        client._rpc(0, {"op": "push", "sender": "w0", "seq": 7},
+                    {"z": np.zeros(16, np.float32),
+                     "w": np.zeros(16, np.float32),
+                     "n": np.zeros(16, np.float32)})
+        node._snap_base = base
+        assert node.snapshot() is not None
+        assert node.snapshot() is None  # clean: nothing new to write
+        want = client.pull()
+        clock = node.clock
+    finally:
+        client.close()
+        node.stop()
+
+    node2 = ServerNode(0, 1, epoch=1)
+    assert node2.restore_snapshot(base)
+    assert node2.clock == clock
+    node2.serve()
+    c2 = PSClient([node2.uri])
+    try:
+        got = c2.pull()
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+        # the fence survived: the pre-crash seq is still deduped
+        h, _ = c2._rpc(0, {"op": "push", "sender": "w0", "seq": 7},
+                       {"z": np.ones(16, np.float32),
+                        "w": np.zeros(16, np.float32),
+                        "n": np.zeros(16, np.float32)})
+        assert h.get("dup") is True
+        h, _ = c2._rpc(0, {"op": "hello", "sender": "w0"})
+        assert h["last_seq"] == 7 and h["epoch"] == 1
+        # restored nonzero rows are stamped: a since=0 versioned pull
+        # reports them (under-delivery would desync worker mirrors)
+        _, groups, got_s = c2.pull_sparse([0])
+        np.testing.assert_array_equal(np.sort(groups[16]),
+                                      np.array([2, 9]))
+        # derived tables still re-derive on new pushes
+        c2.push_sparse({16: np.array([2], np.int64)},
+                       {"w": np.zeros(1, np.float32),
+                        "z": np.full(1, 0.9, np.float32),
+                        "n": np.full(1, 0.25, np.float32)})
+        full = c2.pull()
+        assert full["w"][2] != got["w"][2]
+    finally:
+        c2.close()
+        node2.stop()
+
+
+def test_restore_without_snapshot_restarts_empty(tmp_path):
+    node = ServerNode(0, 1, epoch=1)
+    assert node.restore_snapshot(str(tmp_path / "missing")) is False
+    assert not node.tables
+
+
+def test_no_retry_fails_fast_with_resume_guidance(solo):
+    """The default client (retry_deadline=0) keeps the pre-recovery
+    contract: a dead server fails the op immediately with the restart/
+    resume guidance (the error test_apps.py's fail-fast test greps)."""
+    node, client = solo
+    client.init({"w": np.zeros(4, np.float32)})
+    node.stop()
+    with pytest.raises((ConnectionError, ConnectionResetError),
+                       match="job must be restarted"):
+        for _ in range(3):  # first push may land in the dead socket's
+            client.push({"w": np.ones(4, np.float32)})  # TCP buffer
+
+
+def test_retry_deadline_exhaustion_raises(tmp_path):
+    node = ServerNode(0, 1)
+    node.serve()
+    client = PSClient([node.uri], sender="w0", retry_deadline=1.0)
+    client.init({"w": np.zeros(4, np.float32)})
+    node.stop()
+    with pytest.raises(ConnectionError, match="did not come back"):
+        for _ in range(3):
+            client.push({"w": np.ones(4, np.float32)})
+    client.close()
+
+
+def test_retry_reconnects_and_replays_journal(tmp_path):
+    """The full recovery dance, in-process: server dies AFTER a snapshot
+    but with journaled pushes past it; a respawned epoch-1 server
+    restores the snapshot; the client re-resolves the new URI, fences
+    with hello, replays exactly the unapplied journal entries, and
+    re-pulls from 0 after the rollback — no delta lost, none doubled."""
+    base = str(tmp_path / "srv")
+    node = ServerNode(0, 1)
+    node.serve()
+    holder = {"uris": None}
+    client = PSClient([node.uri], sender="w0", retry_deadline=15.0,
+                      resolver=lambda: holder["uris"])
+    client.init({"w": np.zeros(16, np.float32)})
+    client.push_sparse({16: np.array([1, 2], np.int64)},
+                       {"w": np.ones(2, np.float32)})       # seq 1
+    node._snap_base = base
+    assert node.snapshot() is not None
+    client.push_sparse({16: np.array([3], np.int64)},
+                       {"w": np.ones(1, np.float32)})       # seq 2, NOT
+    snap_clock = node.clock                                 # in snapshot
+    node.stop()  # SIGKILL stand-in: state past the snapshot is gone
+
+    node2 = ServerNode(0, 1, epoch=1)
+    assert node2.restore_snapshot(base)
+    assert node2.clock < snap_clock  # rolled back past seq 2
+    node2.serve()
+    holder["uris"] = [node2.uri]
+
+    # this push hits the dead connection -> recover: re-resolve, hello
+    # (last_seq=1), replay seq 2 from the journal, then send seq 3
+    client.push_sparse({16: np.array([4], np.int64)},
+                       {"w": np.ones(1, np.float32)})       # seq 3
+    assert client.num_retries >= 1
+    assert client.uris == [node2.uri]
+    want = np.zeros(16, np.float32)
+    want[[1, 2, 3, 4]] = 1.0
+    np.testing.assert_array_equal(client.pull()["w"], want)
+    h, _ = client._rpc(0, {"op": "hello", "sender": "w0"})
+    assert h["last_seq"] == 3  # replay + resend, each applied once
+
+    # the epoch bump flagged a rollback: the next versioned pull ignores
+    # its stale `since` and re-adopts the full restored state
+    assert client._rolled_back[0] is True
+    clocks, groups, got = client.pull_sparse([snap_clock + 100])
+    np.testing.assert_array_equal(np.sort(groups[16]),
+                                  np.array([1, 2, 3, 4]))
+    # and once consumed, stale-since pulls are incremental again
+    _, groups2, _ = client.pull_sparse(clocks)
+    assert groups2[16].size == 0
+    client.close()
+    node2.stop()
+
+
+def test_fault_spec_parsing_and_scoping():
+    """WH_FAULT_SPEC grammar + role/rank/epoch scoping: one job-wide
+    spec string arms only in the targeted process."""
+    f = faults.Faults("server:1:kill@push:200", role="server", rank=1)
+    assert f._kills == [("push", 200)]
+    assert not faults.Faults("server:1:kill@push:200",
+                             role="server", rank=0)._kills
+    assert not faults.Faults("server:1:kill@push:200",
+                             role="worker", rank=1)._kills
+    # by default a kill arms only in the FIRST incarnation...
+    assert not faults.Faults("server:1:kill@push:2",
+                             role="server", rank=1, epoch=1)._kills
+    # ...':always' re-arms it after every respawn
+    assert faults.Faults("server:1:kill@push:2:always",
+                         role="server", rank=1, epoch=3)._kills
+    f = faults.Faults("net:delay:ms=5,net:reset:after_frames=3",
+                      role="worker")
+    assert f._delay_s == 0.005 and f._reset_after == 3
+    # net faults never arm inside servers/scheduler
+    assert faults.Faults("net:reset:after_frames=3",
+                         role="server")._reset_after is None
+    f = faults.Faults("sched:drop@register_server:1", role="scheduler")
+    assert f._drops == [("register_server", 1)]
+    for bad in ("bogus:x", "server:0:kill@push:0", "net:nope:ms=1",
+                "server:0:boom", "net:delay:sec=1"):
+        with pytest.raises(faults.FaultSpecError):
+            faults.Faults(bad)
+
+
+def test_fault_kill_fires_at_nth_op():
+    kills = []
+    f = faults.Faults("server:0:kill@push:2", role="server", rank=0)
+    f.kill_fn = kills.append  # don't actually os._exit the test runner
+    f.server_op("push")
+    f.server_op("pull")
+    assert not kills
+    f.server_op("push")
+    assert kills == [faults.KILL_EXIT]
+
+
+def test_net_reset_fault_recovers_exactly_once(solo):
+    """An injected connection reset mid-push: the retry client
+    reconnects and the seq fence guarantees the push applies exactly
+    once, whichever side of the RPC the reset interrupted."""
+    node, client = solo
+    client.init({"w": np.zeros(8, np.float32)})
+    named = PSClient([node.uri], sender="w0", retry_deadline=10.0)
+    assert faults.ACTIVE is None  # the zero-overhead default
+    faults.ACTIVE = faults.Faults("net:reset:after_frames=1",
+                                  role="worker")
+    try:
+        for _ in range(3):
+            named.push({"w": np.ones(8, np.float32)})
+    finally:
+        faults.ACTIVE = None
+        named.close()
+    assert named.num_retries >= 1
+    np.testing.assert_array_equal(client.pull()["w"],
+                                  np.full(8, 3.0, np.float32))

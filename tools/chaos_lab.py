@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Fault-injection lab: run a small difacto job under a matrix of
+WH_FAULT_SPEC scenarios and classify each run against an unfaulted
+baseline.
+
+Three verdicts per scenario:
+
+  survived           rc == 0 and |logloss - baseline| <= --tol
+  FAILED             rc != 0 (or no final metric printed)
+  SILENT-CORRUPTION  rc == 0 but the final logloss drifted past --tol —
+                     the worst outcome: the job "passed" while the
+                     recovery path lost or double-applied state
+
+The default matrix exercises every recovery layer: a server killed
+mid-push (snapshot restore + journal replay), a server killed mid-pull
+(rollback detection -> since=0 re-pull), a worker-side connection reset
+(fenced RPC retry without any server death), and injected latency (no
+fault, just slowness — must stay bit-identical survived).
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/chaos_lab.py
+  python tools/chaos_lab.py --specs "server:0:kill@push:60" --restarts 2
+  python tools/chaos_lab.py --no-recovery   # verify fail-fast still fails
+
+Each scenario is a fresh launcher subprocess, so a hard server exit
+(os._exit in runtime/faults.py) is a real process death — the same
+SIGKILL-shaped hole tests/test_apps.py's chaos tests punch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_SPECS = [
+    "server:0:kill@push:60",
+    "server:0:kill@pull:25",
+    "net:reset:after_frames=50",
+    "net:delay:ms=2",
+]
+
+
+def synth_libsvm(path: str, n_rows: int, seed: int, n_feat: int = 1000,
+                 nnz: int = 8, w_seed: int = 1234) -> None:
+    """Synthetic near-separable sparse data (tests/conftest.py recipe):
+    every file draws from the SAME ground-truth model so train and val
+    are consistent."""
+    rng = np.random.default_rng(seed)
+    w = np.random.default_rng(w_seed).normal(size=n_feat)
+    lines = []
+    for _ in range(n_rows):
+        idx = rng.choice(n_feat, size=nnz, replace=False)
+        val = rng.random(nnz).astype(np.float32) + 0.5
+        y = 1 if float((w[idx] * val).sum()) + rng.normal(scale=0.3) > 0 \
+            else 0
+        lines.append(f"{y} " + " ".join(
+            f"{i}:{v:.4f}" for i, v in zip(idx, val)))
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def run_job(conf: str, spec: str, workers: int, servers: int,
+            restarts: int, timeout: float) -> tuple[int, str, float]:
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("WH_FAULT_SPEC", None)
+    if spec:
+        env["WH_FAULT_SPEC"] = spec
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", str(workers), "-s", str(servers),
+         "--node-timeout", "10",
+         "--max-server-restarts", str(restarts), "--",
+         sys.executable, "-m", "wormhole_tpu.apps.difacto", conf],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    return r.returncode, r.stdout + r.stderr, time.monotonic() - t0
+
+
+def final_logloss(out: str) -> float | None:
+    m = re.search(r"final val: logloss=([0-9.]+)", out)
+    return float(m.group(1)) if m else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fault-injection matrix for the ps recovery path")
+    ap.add_argument("--specs", nargs="*", default=DEFAULT_SPECS,
+                    help="WH_FAULT_SPEC values to run (see "
+                         "runtime/faults.py for the grammar)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--servers", type=int, default=2)
+    ap.add_argument("--restarts", type=int, default=1,
+                    help="--max-server-restarts for the faulted runs")
+    ap.add_argument("--no-recovery", action="store_true",
+                    help="run the matrix with recovery OFF: every "
+                         "server-kill scenario should then FAIL fast "
+                         "(the pre-recovery fail-fast contract)")
+    ap.add_argument("--rows", type=int, default=512,
+                    help="rows per train part (2 parts + 1 val file)")
+    ap.add_argument("--passes", type=int, default=4)
+    ap.add_argument("--tol", type=float, default=0.05,
+                    help="|logloss - baseline| above this flags "
+                         "silent corruption (bounded-staleness runs "
+                         "already wobble a little)")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir (data + confs)")
+    args = ap.parse_args(argv)
+
+    scratch = tempfile.mkdtemp(prefix="wh_chaos_")
+    for i in range(2):
+        synth_libsvm(os.path.join(scratch, f"train-{i}.libsvm"),
+                     args.rows, seed=i)
+    synth_libsvm(os.path.join(scratch, "val.libsvm"), args.rows, seed=9)
+    conf = os.path.join(scratch, "chaos.conf")
+    with open(conf, "w") as fh:
+        fh.write(f"""
+train_data = "{scratch}/train-.*"
+val_data = "{scratch}/val.libsvm"
+algo = ftrl
+dim = 4
+threshold = 2
+lambda_l1 = 0.5
+minibatch = 128
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = {args.passes}
+max_delay = 1
+""")
+
+    restarts = 0 if args.no_recovery else args.restarts
+    print(f"[chaos] scratch={scratch} workers={args.workers} "
+          f"servers={args.servers} max_server_restarts={restarts}")
+
+    rc, out, dt = run_job(conf, "", args.workers, args.servers,
+                          restarts, args.timeout)
+    base = final_logloss(out)
+    if rc != 0 or base is None:
+        print(out[-4000:])
+        print(f"[chaos] baseline (no fault) FAILED rc={rc} — nothing to "
+              "compare against; fix the clean path first")
+        return 2
+    print(f"[chaos] baseline: logloss={base:.5f} ({dt:.0f}s)")
+
+    rows, worst = [], 0
+    for spec in args.specs:
+        rc, out, dt = run_job(conf, spec, args.workers, args.servers,
+                              restarts, args.timeout)
+        ll = final_logloss(out)
+        if rc != 0 or ll is None:
+            verdict, detail = "FAILED", f"rc={rc} logloss={ll}"
+            worst = max(worst, 1)
+            tail = "\n".join(out.splitlines()[-12:])
+            detail += "\n    " + tail.replace("\n", "\n    ")
+        elif abs(ll - base) > args.tol:
+            verdict = "SILENT-CORRUPTION"
+            detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            worst = max(worst, 3)
+        else:
+            verdict = "survived"
+            detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            # a "survival" during which the fault never fired proves
+            # nothing — call it out so the spec gets retuned (e.g. a
+            # kill/reset count the short job never reaches)
+            if ("kill" in spec or "reset" in spec) \
+                    and not re.search(r"\[faults\] (injecting|server rank)",
+                                      out):
+                verdict = "survived (fault never fired!)"
+        recov = len(re.findall(r"respawning with restore epoch", out))
+        retries = len(re.findall(r"\[ps-retry\]", out))
+        rows.append((spec, verdict, detail, recov, retries, dt))
+        print(f"[chaos] {spec}: {verdict} ({detail.splitlines()[0]}, "
+              f"{recov} respawns, {retries} retry events, {dt:.0f}s)")
+
+    print(f"\n{'spec':<34} {'verdict':<18} {'respawns':>8} "
+          f"{'retries':>8} {'sec':>5}")
+    for spec, verdict, detail, recov, retries, dt in rows:
+        print(f"{spec:<34} {verdict:<18} {recov:>8} {retries:>8} "
+              f"{dt:>5.0f}")
+        print(f"    {detail.splitlines()[0]}")
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+    return worst if worst != 1 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

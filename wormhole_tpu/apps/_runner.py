@@ -25,6 +25,7 @@ file-throughput test mode only; rank 0 alone saves its replica.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -315,7 +316,14 @@ def _wait_server_group(sched: Scheduler, timeout: float = 60.0) -> PSClient:
                 f"{timeout:.0f}s ({len(sched._server_uris)}"
                 f"/{sched.num_servers})")
         time.sleep(0.2)
-    return PSClient(_server_uris(sched))
+    # under recovery (launcher exports WH_PS_RETRY_SEC) the command
+    # channel must survive a server respawn too: a dead server's save/
+    # load lands on its reborn URI, which the scheduler itself holds
+    # authoritatively via re-registration
+    retry = float(os.environ.get("WH_PS_RETRY_SEC", "0") or 0)
+    return PSClient(_server_uris(sched), retry_deadline=retry,
+                    resolver=(lambda: _server_uris(sched))
+                    if retry > 0 else None)
 
 
 _MODEL_LOADED_KEY = "__ps_model_loaded__"
@@ -411,8 +419,11 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
         # node_timeout only bounds ping gaps of REGISTERED workers),
         # none is coming — exit LOUDLY instead of holding the scheduler
         # for the full drain bound
-        none_deadline = time.monotonic() + max(60.0,
-                                               sched.node_timeout * 2)
+        # the same bound as drain_deadline: a max_data_pass=0 job whose
+        # workers spend 60-120s in JAX/TPU init must not find the PS
+        # plane torn down the moment they register (ADVICE #1)
+        none_deadline = time.monotonic() + max(120.0,
+                                               sched.node_timeout * 4)
         while (not sched.workers_drained(env.num_workers)
                and time.monotonic() < drain_deadline):
             if (sched.workers_ever_seen() == 0
@@ -435,11 +446,29 @@ def _server_uris(sched: Scheduler) -> list[str]:
 
 
 def _run_server(cfg, env) -> dict:
-    """One ps server process: bucket-range shard owner."""
-    node = ServerNode(env.rank, env.num_servers)
+    """One ps server process: bucket-range shard owner. When the
+    launcher provides a snapshot dir (WH_SNAPSHOT_DIR), the node writes
+    periodic async shard snapshots there, and a respawned incarnation
+    (WH_RESTORE_EPOCH > 0) restores from them before serving — then
+    re-announces its NEW uri through the scheduler (register_server
+    overwrites the rank's entry, and worker-side retry re-resolves)."""
+    epoch = int(os.environ.get("WH_RESTORE_EPOCH", "0") or 0)
+    node = ServerNode(env.rank, env.num_servers, epoch=epoch)
+    snap_dir = os.environ.get("WH_SNAPSHOT_DIR", "")
+    if snap_dir:
+        snap_base = os.path.join(snap_dir, "srv")
+        if epoch > 0:
+            if not node.restore_snapshot(snap_base):
+                print(f"[ps server {env.rank}] respawn epoch {epoch}: no "
+                      "snapshot yet — restarting empty (pre-first-"
+                      "snapshot state is not recoverable)", flush=True)
     node.serve()
     client = SchedulerClient(env.scheduler_uri, f"server-{env.rank}")
     client.call(op="register_server", rank=env.rank, uri=node.uri)
+    if snap_dir:
+        node.start_snapshots(os.path.join(snap_dir, "srv"),
+                             float(getattr(cfg, "server_snapshot_sec", 5.0)
+                                   or 5.0))
     try:
         while not node.wait_shutdown(2.0):
             client.call(op="epoch")  # liveness ping
@@ -504,7 +533,26 @@ def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
                     raise RuntimeError(
                         "scheduler never announced the model_in load")
                 time.sleep(0.2)
-        ps = PSClient(s["uris"])
+        # server-death recovery (opt-in): with a retry budget the client
+        # survives a dead server — it re-resolves the rank's NEW uri
+        # through the scheduler, fences with `hello`, and replays its
+        # push journal (the server's seq dedup makes over-replay safe).
+        # Zero (the default) keeps the original fail-fast behavior.
+        retry_sec = float(os.environ.get("WH_PS_RETRY_SEC", "0") or 0)
+        cfg_retry = float(getattr(cfg, "ps_retry_sec", 0.0) or 0.0)
+        if cfg_retry > 0:
+            retry_sec = cfg_retry
+
+        def _resolve():
+            try:
+                got = client.call(op="servers")
+                return got["uris"] if got.get("ready") else None
+            except Exception:
+                return None
+
+        ps = PSClient(s["uris"], sender=f"worker-{env.rank}",
+                      retry_deadline=retry_sec,
+                      resolver=_resolve if retry_sec > 0 else None)
         learner.track_touched = hasattr(learner, "collect_touched")
         synced = SyncedStore(
             _store(learner), ps,

@@ -66,6 +66,19 @@ class LocalFS:
         return os.path.getsize(path)
 
 
+def _check_write_mode(scheme: str, mode: str) -> None:
+    """Object stores only support whole-object replacement: an 'a'/'+'
+    open silently truncate-writing (e.g. `write_crb(append=True)` onto
+    an existing object) would REPLACE data the caller meant to extend —
+    refuse loudly instead of corrupting (ADVICE #2)."""
+    if "a" in mode or "+" in mode:
+        raise NotImplementedError(
+            f"{scheme}:// objects cannot be opened in {mode!r}: object "
+            "stores only support whole-object writes (no append/update-"
+            "in-place). Download, modify, and re-upload — or write to a "
+            "new object.")
+
+
 class GcsFS:
     """gs:// over google-cloud-storage (present on most TPU VMs).
     Reads download whole blobs into memory buffers (data files are
@@ -92,6 +105,7 @@ class GcsFS:
             data = self._blob(path).download_as_bytes()
             return io.BytesIO(data) if "b" in mode else io.StringIO(
                 data.decode("utf-8", errors="replace"))
+        _check_write_mode("gs", mode)
         blob = self._blob(path)
 
         class _Upload(io.BytesIO):
@@ -99,7 +113,10 @@ class GcsFS:
                 blob.upload_from_string(self_inner.getvalue())
                 super().close()
 
-        return _Upload()
+        buf = _Upload()
+        if "b" not in mode:
+            return io.TextIOWrapper(buf, encoding="utf-8")
+        return buf
 
     def list_dir(self, path: str) -> list[str]:
         bucket, _, prefix = path.partition("/")
@@ -156,6 +173,7 @@ class S3FS:
                 Bucket=bucket, Key=key)["Body"].read()
             return io.BytesIO(data) if "b" in mode else io.StringIO(
                 data.decode("utf-8", errors="replace"))
+        _check_write_mode("s3", mode)
         client = self._client
 
         class _Upload(io.BytesIO):
@@ -164,7 +182,10 @@ class S3FS:
                                   Body=self_inner.getvalue())
                 super().close()
 
-        return _Upload()
+        buf = _Upload()
+        if "b" not in mode:
+            return io.TextIOWrapper(buf, encoding="utf-8")
+        return buf
 
     def _iter_keys(self, bucket: str, prefix: str):
         token = None

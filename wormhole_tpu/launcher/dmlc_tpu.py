@@ -82,6 +82,8 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            remote_cwd: str | None = None,
            scheduler_host: str | None = None,
            coord_port: int = 0,
+           max_server_restarts: int = 0,
+           snapshot_dir: str | None = None,
            pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
                                         "PYTHONPATH")) -> int:
     """Spawn the scheduler + N workers of `cmd`; stream their output with
@@ -95,8 +97,23 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     `<ssh_cmd> <host> 'cd <remote_cwd> && env <contract> <cmd>'` — the
     same WH_* env contract either way, with the scheduler URI bound on a
     launch-host address the remote nodes can dial. The jax.distributed
-    coordinator lands on hosts[0] (worker 0's host) at `coord_port`."""
+    coordinator lands on hosts[0] (worker 0's host) at `coord_port`.
+
+    With `max_server_restarts > 0` the launcher becomes the ps plane's
+    supervisor (the ps-lite node-manager role): a server process that
+    dies mid-job is respawned — up to the cap, per rank — with
+    WH_RESTORE_EPOCH bumped so it restores its latest shard snapshot
+    from `snapshot_dir` (auto-allocated when not given) and re-announces
+    its new URI; workers ride the death out through PSClient's fenced
+    retry (WH_PS_RETRY_SEC, exported automatically). Snapshot respawn is
+    local-launch only for now (a remote host's respawn would need the
+    ssh round-trip plumbed through the stream threads)."""
     multi = bool(hosts)
+    recovery = max_server_restarts > 0 and num_servers > 0
+    if recovery and snapshot_dir is None:
+        import tempfile
+
+        snapshot_dir = tempfile.mkdtemp(prefix="wh_ps_snap_")
     if multi:
         sched_host = scheduler_host or _default_host_ip()
         if not sched_host:
@@ -127,23 +144,36 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             WH_COORD_URI=coord_uri,
             WH_NODE_TIMEOUT=str(node_timeout),
         )
+        if snapshot_dir:
+            env["WH_SNAPSHOT_DIR"] = snapshot_dir
+        if recovery and not os.environ.get("WH_PS_RETRY_SEC"):
+            # worker-side retry budget: generous enough to span a server
+            # death + respawn + snapshot restore + re-registration; an
+            # exported WH_PS_RETRY_SEC (or env_extra below) overrides
+            env["WH_PS_RETRY_SEC"] = str(max(120.0, node_timeout * 4))
         if env_extra:
             env.update({k: str(v) for k, v in env_extra.items()})
         return env
 
-    def spawn(role: str, rank: int) -> subprocess.Popen:
+    def spawn(role: str, rank: int,
+              extra: dict | None = None) -> subprocess.Popen:
         env = dict(os.environ)
         env.update(contract(role, rank))
+        if extra:
+            env.update(extra)
         return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT)
 
-    def spawn_remote(role: str, rank: int) -> subprocess.Popen:
+    def spawn_remote(role: str, rank: int,
+                     extra: dict | None = None) -> subprocess.Popen:
         # workers spread over hosts by rank; servers continue the
         # round-robin after them so a host gets at most
         # ceil((n+s)/len(hosts)) processes
         slot = rank if role == "worker" else num_workers + rank
         host = hosts[slot % len(hosts)]
         kv = dict(contract(role, rank))
+        if extra:
+            kv.update(extra)
         for k in pass_env:
             if k in os.environ and k not in kv:
                 kv[k] = os.environ[k]
@@ -157,20 +187,61 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
 
     role_spawn = spawn_remote if multi else spawn
     sched = spawn("scheduler", 0)  # the tracker node always runs locally
-    servers = [role_spawn("server", r) for r in range(num_servers)]
+    server_procs = {r: role_spawn("server", r) for r in range(num_servers)}
     workers = [role_spawn("worker", r) for r in range(num_workers)]
     procs = {"scheduler": sched}
-    procs.update({f"server-{r}": p for r, p in enumerate(servers)})
+    procs.update({f"server-{r}": p for r, p in server_procs.items()})
     procs.update({f"worker-{r}": p for r, p in enumerate(workers)})
     threads = []
-    for name, p in procs.items():
+
+    def watch_output(name: str, p: subprocess.Popen) -> None:
         t = threading.Thread(target=_stream,
                              args=(name, p.stdout, sys.stdout.buffer),
                              daemon=True)
         t.start()
         threads.append(t)
+
+    for name, p in procs.items():
+        watch_output(name, p)
+
+    stop_respawn = threading.Event()
+
+    def respawn_loop(r: int) -> None:
+        """Supervise server rank r: a nonzero/signal exit mid-job gets
+        the process respawned with a bumped WH_RESTORE_EPOCH (snapshot
+        restore), up to the cap."""
+        restarts = 0
+        while True:
+            p = server_procs[r]
+            code = p.wait()
+            if stop_respawn.is_set() or code == 0:
+                return
+            if restarts >= max_server_restarts:
+                print(f"[dmlc_tpu] ERROR: ps server-{r} died again "
+                      f"(exit {code}) and max_server_restarts="
+                      f"{max_server_restarts} is exhausted; not "
+                      "respawning — the job will fail", flush=True)
+                return
+            restarts += 1
+            print(f"[dmlc_tpu] ps server-{r} died (exit {code}); "
+                  f"respawning with restore epoch {restarts} "
+                  f"({restarts}/{max_server_restarts})", flush=True)
+            np_ = role_spawn("server", r,
+                             {"WH_RESTORE_EPOCH": str(restarts)})
+            server_procs[r] = np_
+            procs[f"server-{r}"] = np_
+            watch_output(f"server-{r}", np_)
+
+    monitors = []
+    if recovery:
+        for r in range(num_servers):
+            m = threading.Thread(target=respawn_loop, args=(r,),
+                                 daemon=True)
+            m.start()
+            monitors.append(m)
     try:
         rc = sched.wait()
+        stop_respawn.set()  # teardown begins: server exits are expected
         # give workers a grace period to drain, then terminate leftovers.
         # A signal death is a NEGATIVE returncode — fold it to a
         # nonzero exit instead of letting max() hide it behind a clean
@@ -179,19 +250,27 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             nonlocal rc
             if code != 0 and rc == 0:
                 rc = code if code > 0 else 1
-        for p in workers + servers:
+        for p in workers + list(server_procs.values()):
             try:
-                fold(p.wait(timeout=10))
+                code = p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.send_signal(signal.SIGTERM)
                 try:
-                    fold(p.wait(timeout=5))
+                    code = p.wait(timeout=5)
                 except subprocess.TimeoutExpired:
                     p.kill()
-                    fold(1)
+                    code = 1
+            if recovery and p in server_procs.values():
+                # with supervision on, a server's exit code is not the
+                # job's: an injected/real kill that recovery absorbed
+                # must not fail a run whose workers finished clean
+                # (failures surface through workers or the scheduler)
+                continue
+            fold(code)
         return rc
     finally:
-        for p in procs.values():
+        stop_respawn.set()
+        for p in list(procs.values()):
             if p.poll() is None:
                 p.kill()
         for t in threads:
@@ -206,6 +285,15 @@ def main(argv=None) -> int:
     ap.add_argument("-s", "--num-servers", type=int, default=1,
                     help="parameter-server processes (0 = replica mode)")
     ap.add_argument("--node-timeout", type=float, default=30.0)
+    ap.add_argument("--max-server-restarts", type=int, default=0,
+                    help="respawn a dead ps server up to N times per "
+                         "rank, restoring its latest shard snapshot "
+                         "(0 = no recovery: a server death fails the "
+                         "job fast with resume guidance)")
+    ap.add_argument("--snapshot-dir", default=None,
+                    help="directory for the servers' periodic shard "
+                         "snapshots (default: a fresh temp dir when "
+                         "recovery is on)")
     ap.add_argument("-H", "--hosts", default=None,
                     help="comma-separated hosts to spawn role processes "
                          "on via --ssh-cmd (scheduler stays local); "
@@ -247,7 +335,9 @@ def main(argv=None) -> int:
                   hosts=hosts or None, ssh_cmd=args.ssh_cmd,
                   remote_cwd=args.remote_cwd,
                   scheduler_host=args.scheduler_host,
-                  coord_port=args.coord_port)
+                  coord_port=args.coord_port,
+                  max_server_restarts=args.max_server_restarts,
+                  snapshot_dir=args.snapshot_dir)
 
 
 if __name__ == "__main__":

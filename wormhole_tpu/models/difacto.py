@@ -431,10 +431,15 @@ class DifactoLearner:
         rm_slot, (rm_wval, rm_vval), over = ck.build_rm(
             seg, slot_nz, val, mb, W, uw_cap,
             extra=(np.where(keepv, vval, 0.0),))
+        rm_dropped = 0
         if len(over):
-            # overflow beyond nnz_per_row: drop from EVERY layout (rm
-            # forward, wcoo backward, vcoo backward) so pull and push
-            # agree about which nonzeros exist
+            # overflow beyond nnz_per_row: since the forward's xw rides
+            # the SAME row-major layout, a row's nonzeros past
+            # nnz_per_row are dropped from EVERY layout (rm forward —
+            # including the linear xw term — wcoo backward, vcoo
+            # backward) so pull and push agree about which nonzeros
+            # exist
+            rm_dropped = int(np.count_nonzero(val[over]))
             val = val.copy()
             val[over] = 0.0
             mask_src = np.ones(len(seg), bool)
@@ -452,13 +457,23 @@ class DifactoLearner:
         ok = loc_v.uniq_keys[li] == vkeys
         vs = np.minimum(ts_v.slot_of_uniq[li], uv_cap).astype(np.int32)
         vslot_w[w_slots_valid] = np.where(ok, vs, uv_cap)
-        if dropped:
+        if dropped or rm_dropped:
+            # two distinct causes with distinct remedies, counted
+            # separately so an undersized nnz_per_row is diagnosable
+            # (ADVICE #4): slot-cap overflow (the compact W/V tables
+            # sized off the first batch ran out of slots — raise
+            # compact caps / first-batch key diversity) vs row-cap
+            # overflow (a row carried more than nnz_per_row nonzeros —
+            # raise nnz_per_row; note the rm layout caps the xw forward
+            # too, not just the V embeddings)
             import logging
 
             logging.getLogger(__name__).warning(
-                "fm compaction overflow: dropped %d nonzeros — raise "
-                "the first batch's key diversity (caps %s)",
-                dropped, self._fm_caps)
+                "fm compaction overflow: dropped %d nonzeros to the "
+                "slot caps (caps %s — raise key diversity of the first "
+                "batch) and %d to the nnz_per_row row cap (%d — raise "
+                "nnz_per_row; the row-major forward caps xw too)",
+                dropped, self._fm_caps, rm_dropped, W)
         if not train:
             # eval/predict never scatter: the sorted COO streams (and
             # their radix sorts) are a train-only cost

@@ -91,6 +91,14 @@ class LinearConfig:
     # config.proto local_data)
     dispatch: str = "online"
     local_data: bool = False
+    # fault tolerance (docs/distributed.md "Fault tolerance"): cadence of
+    # the ps servers' async shard snapshots (effective only when the
+    # launcher provides a snapshot dir), and the worker-side PS retry
+    # budget in seconds — 0 keeps the default fail-fast-on-server-death
+    # behavior; the launcher's --max-server-restarts exports a matching
+    # budget via WH_PS_RETRY_SEC, which a nonzero value here overrides
+    server_snapshot_sec: float = 5.0
+    ps_retry_sec: float = 0.0
     # global-mesh mode: the -n worker processes jax.distributed-initialize
     # into ONE SPMD mesh; gradients aggregate over ICI/DCN collectives
     # instead of the TCP parameter server (parallel/multihost.py)
@@ -493,10 +501,13 @@ class LinearLearner:
                 fixed_bytes=cfg.fixed_bytes, dtype=self._coo_dtype)
             return new_state, _progress(obj, xw, label, mask, new_w)
 
+        # eval/predict read only the gathered compact w and the row-major
+        # (rm_slot, rm_val) pull — the COO stream and update-block bounds
+        # are train-only, so shipping them host→device every eval batch
+        # was pure waste (ADVICE #3)
         @jax.jit
-        def eval_step_tcoo(state, uniq, tmap_u, first_u, last_u,
-                           sidx, sseg, sval, tmap, first,
-                           rm_slot, rm_val, label, mask):
+        def eval_step_tcoo(state, uniq, tmap_u, rm_slot, rm_val,
+                           label, mask):
             w2 = state["w"].reshape(-1, ck.LANES)
             wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
             xw = rm_xw_c(wc, rm_slot, rm_val)
@@ -504,9 +515,7 @@ class LinearLearner:
             return _progress(obj, xw, label, mask)
 
         @jax.jit
-        def predict_step_tcoo(state, uniq, tmap_u, first_u, last_u,
-                              sidx, sseg, sval, tmap, first,
-                              rm_slot, rm_val):
+        def predict_step_tcoo(state, uniq, tmap_u, rm_slot, rm_val):
             w2 = state["w"].reshape(-1, ck.LANES)
             wc = ck.tile_gather(w2, uniq, tmap_u, dtype=self._coo_dtype)
             return rm_xw_c(wc, rm_slot, rm_val)
@@ -622,7 +631,8 @@ class LinearLearner:
         elif b[0] == "tcoo":
             _, tc, label, mask, _ = b
             self.store.state, prog = self._tcoo_steps[0](
-                self.store.state, *self._tcoo_args(tc, label, mask))
+                self.store.state,
+                *self._tcoo_args(tc, label, mask, train=True))
         elif b[0] == "coo":
             _, p, label, mask, _ = b
             self.store.state, prog = self._train_step_coo(
@@ -678,13 +688,18 @@ class LinearLearner:
             out = 1.0 / (1.0 + np.exp(-out))
         return out
 
-    def _tcoo_args(self, tc, label=None, mask=None):
-        p = tc.coo
-        args = [jnp.asarray(tc.uniq), jnp.asarray(tc.tmap_u),
-                jnp.asarray(tc.first_u), jnp.asarray(tc.last_u),
-                jnp.asarray(p.idx), jnp.asarray(p.seg), jnp.asarray(p.val),
-                jnp.asarray(p.tmap), jnp.asarray(p.first),
-                jnp.asarray(tc.rm_slot), jnp.asarray(tc.rm_val)]
+    def _tcoo_args(self, tc, label=None, mask=None, train=False):
+        # the COO stream + update-block bounds feed only the train step's
+        # gradient transpose and fused scatter; eval/predict take the
+        # short form (see eval_step_tcoo)
+        args = [jnp.asarray(tc.uniq), jnp.asarray(tc.tmap_u)]
+        if train:
+            p = tc.coo
+            args += [jnp.asarray(tc.first_u), jnp.asarray(tc.last_u),
+                     jnp.asarray(p.idx), jnp.asarray(p.seg),
+                     jnp.asarray(p.val), jnp.asarray(p.tmap),
+                     jnp.asarray(p.first)]
+        args += [jnp.asarray(tc.rm_slot), jnp.asarray(tc.rm_val)]
         if label is not None:
             args += [jnp.asarray(label), jnp.asarray(mask)]
         return args

@@ -4,13 +4,37 @@ Connection establishment retries with backoff (the launcher spawns all
 node processes concurrently, so clients routinely race ahead of a
 server's bind); once a connection exists, request/response failures are
 NOT retried here — the ops they carry (barrier entry, part assignment)
-are not idempotent, so replay policy belongs to the caller.
+are not idempotent, so replay policy belongs to the caller. (The PS
+data plane layers a fenced, idempotent retry on top: PSClient stamps
+pushes with per-sender sequence numbers the servers deduplicate, which
+is what makes ITS replay safe — see runtime/ps_server.py.)
+
+This module also owns the PS wire format. Frame = 4-byte big-endian
+header length | JSON header | raw payload. header = {"op": str, ...meta,
+"arrays": [{"name", "shape", "enc", "scale", "nbytes"}, ...]}; payload =
+buffers concatenated in array order. Integer arrays (sparse-push/pull
+row indices) ride the same frame with enc="i32"/"i64"; "comp": "zlib"
+marks a compressed buffer ("nbytes" is then the compressed size,
+"rawbytes" the original).
+
+Fault injection (runtime/faults.py) hooks frame send/recv; the guards
+are module-level None checks so an unfaulted process pays nothing.
 """
 
 from __future__ import annotations
 
+import json
 import socket
+import struct
 import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from wormhole_tpu.runtime import faults
+
+_COMPRESS_MIN = 512  # don't bother compressing tiny buffers
 
 
 def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
@@ -27,3 +51,118 @@ def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
                 raise
             time.sleep(backoff)
             backoff = min(backoff * 2, 1.0)
+
+
+def _encode(a: np.ndarray, fixed_bytes: int = 0,
+            compress: bool = False) -> tuple[dict, bytes]:
+    """Encode one array for the wire. Float arrays honor fixed_bytes:
+    0 = raw f32, 2 = bfloat16 bit-truncation (round-to-nearest-even),
+    1 = absmax int8. Integer arrays always go raw (they are row indices;
+    rounding them would corrupt the scatter)."""
+    meta: dict = {"shape": list(a.shape)}
+    if np.issubdtype(a.dtype, np.integer):
+        a = np.ascontiguousarray(
+            a, dtype=np.int64 if a.dtype.itemsize > 4 else np.int32)
+        buf = a.tobytes()
+        meta.update(enc="i64" if a.dtype == np.int64 else "i32",
+                    nbytes=len(buf))
+    else:
+        a = np.ascontiguousarray(a, dtype=np.float32)
+        if fixed_bytes == 0:
+            buf = a.tobytes()
+            meta.update(enc="raw", nbytes=len(buf))
+        elif fixed_bytes >= 2:
+            u = a.view(np.uint32)
+            # round-to-nearest-even to the high 16 bits (bfloat16)
+            rounded = (u + 0x7FFF + ((u >> 16) & 1)) >> 16
+            buf = rounded.astype(np.uint16).tobytes()
+            meta.update(enc="bf16", nbytes=len(buf))
+        else:
+            scale = float(max(np.max(np.abs(a), initial=0.0), 1e-30) / 127.0)
+            q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+            buf = q.tobytes()
+            meta.update(enc="int8", scale=scale, nbytes=len(buf))
+    if compress and len(buf) >= _COMPRESS_MIN:
+        c = zlib.compress(buf, 1)
+        if len(c) < len(buf):
+            meta.update(comp="zlib", rawbytes=meta["nbytes"], nbytes=len(c))
+            buf = c
+    return meta, buf
+
+
+def _decode(meta: dict, buf: bytes) -> np.ndarray:
+    shape = tuple(meta["shape"])
+    enc = meta["enc"]
+    if meta.get("comp") == "zlib":
+        buf = zlib.decompress(buf)
+    if enc == "raw":
+        return np.frombuffer(buf, np.float32).reshape(shape).copy()
+    if enc == "i32":
+        return np.frombuffer(buf, np.int32).reshape(shape).copy()
+    if enc == "i64":
+        return np.frombuffer(buf, np.int64).reshape(shape).copy()
+    if enc == "bf16":
+        u = np.frombuffer(buf, np.uint16).astype(np.uint32) << 16
+        return u.view(np.float32).reshape(shape).copy()
+    if enc == "int8":
+        q = np.frombuffer(buf, np.int8).astype(np.float32)
+        return (q * meta["scale"]).reshape(shape)
+    raise ValueError(f"unknown encoding {enc!r}")
+
+
+def _read_exact(sock_file, n: int) -> Optional[bytes]:
+    chunks = []
+    while n > 0:
+        c = sock_file.read(n)
+        if not c:
+            return None
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def send_frame(sock_file, header: dict,
+               arrays: Optional[dict[str, np.ndarray]] = None,
+               fixed_bytes: int = 0, compress: bool = False) -> int:
+    """Write one frame; returns the number of payload+header bytes sent
+    (the wire-accounting unit PSClient reports)."""
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.frame(header.get("op"))
+    metas, bufs = [], []
+    for name, a in (arrays or {}).items():
+        m, b = _encode(a, fixed_bytes, compress)
+        m["name"] = name
+        metas.append(m)
+        bufs.append(b)
+    header = dict(header, arrays=metas)
+    h = json.dumps(header).encode()
+    sock_file.write(struct.pack(">I", len(h)))
+    sock_file.write(h)
+    total = 4 + len(h)
+    for b in bufs:
+        sock_file.write(b)
+        total += len(b)
+    sock_file.flush()
+    return total
+
+
+def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.recv()
+    raw = _read_exact(sock_file, 4)
+    if raw is None:
+        return None
+    (hlen,) = struct.unpack(">I", raw)
+    h = _read_exact(sock_file, hlen)
+    if h is None:
+        return None
+    header = json.loads(h)
+    total = 4 + hlen
+    arrays = {}
+    for m in header.get("arrays", []):
+        buf = _read_exact(sock_file, m["nbytes"])
+        if buf is None:
+            return None
+        total += m["nbytes"]
+        arrays[m["name"]] = _decode(m, buf)
+    return header, arrays, total

@@ -43,146 +43,30 @@ URIs are known.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import time
 import socket
 import socketserver
-import struct
 import threading
-import zlib
 from typing import Callable, Optional
 
 import numpy as np
 
-from wormhole_tpu.runtime.net import connect_with_retry
+from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime.net import (  # noqa: F401  (re-exported: the wire
+    _COMPRESS_MIN, _decode, _encode, _read_exact, connect_with_retry,
+    recv_frame, send_frame)  # format moved to net.py so fault injection can
+# hook frame send/recv for every net user; tests and tools keep importing
+# the names from here.
 
-# ------------------------------------------------------------ wire format
-# Frame = 4-byte big-endian header length | JSON header | raw payload.
-# header = {"op": str, ...meta, "arrays": [{"name", "shape", "enc",
-#           "scale", "nbytes"}, ...]}; payload = buffers concatenated in
-# array order. Integer arrays (sparse-push/pull row indices) ride the
-# same frame with enc="i32"/"i64"; "comp": "zlib" marks a compressed
-# buffer ("nbytes" is then the compressed size, "rawbytes" the original).
-
-_COMPRESS_MIN = 512  # don't bother compressing tiny buffers
 # init_spec claim TTL: how long a server waits for a claimant's
 # init_arrays before handing the claim to the next poller. Clients wait
 # 2x this by default so at least one full re-claim cycle fits inside the
 # client deadline (a claimant dying right after claiming stays
 # recoverable instead of racing the waiters' own timeout).
 INIT_CLAIM_TTL = 300.0
-
-
-def _encode(a: np.ndarray, fixed_bytes: int = 0,
-            compress: bool = False) -> tuple[dict, bytes]:
-    """Encode one array for the wire. Float arrays honor fixed_bytes:
-    0 = raw f32, 2 = bfloat16 bit-truncation (round-to-nearest-even),
-    1 = absmax int8. Integer arrays always go raw (they are row indices;
-    rounding them would corrupt the scatter)."""
-    meta: dict = {"shape": list(a.shape)}
-    if np.issubdtype(a.dtype, np.integer):
-        a = np.ascontiguousarray(
-            a, dtype=np.int64 if a.dtype.itemsize > 4 else np.int32)
-        buf = a.tobytes()
-        meta.update(enc="i64" if a.dtype == np.int64 else "i32",
-                    nbytes=len(buf))
-    else:
-        a = np.ascontiguousarray(a, dtype=np.float32)
-        if fixed_bytes == 0:
-            buf = a.tobytes()
-            meta.update(enc="raw", nbytes=len(buf))
-        elif fixed_bytes >= 2:
-            u = a.view(np.uint32)
-            # round-to-nearest-even to the high 16 bits (bfloat16)
-            rounded = (u + 0x7FFF + ((u >> 16) & 1)) >> 16
-            buf = rounded.astype(np.uint16).tobytes()
-            meta.update(enc="bf16", nbytes=len(buf))
-        else:
-            scale = float(max(np.max(np.abs(a), initial=0.0), 1e-30) / 127.0)
-            q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
-            buf = q.tobytes()
-            meta.update(enc="int8", scale=scale, nbytes=len(buf))
-    if compress and len(buf) >= _COMPRESS_MIN:
-        c = zlib.compress(buf, 1)
-        if len(c) < len(buf):
-            meta.update(comp="zlib", rawbytes=meta["nbytes"], nbytes=len(c))
-            buf = c
-    return meta, buf
-
-
-def _decode(meta: dict, buf: bytes) -> np.ndarray:
-    shape = tuple(meta["shape"])
-    enc = meta["enc"]
-    if meta.get("comp") == "zlib":
-        buf = zlib.decompress(buf)
-    if enc == "raw":
-        return np.frombuffer(buf, np.float32).reshape(shape).copy()
-    if enc == "i32":
-        return np.frombuffer(buf, np.int32).reshape(shape).copy()
-    if enc == "i64":
-        return np.frombuffer(buf, np.int64).reshape(shape).copy()
-    if enc == "bf16":
-        u = np.frombuffer(buf, np.uint16).astype(np.uint32) << 16
-        return u.view(np.float32).reshape(shape).copy()
-    if enc == "int8":
-        q = np.frombuffer(buf, np.int8).astype(np.float32)
-        return (q * meta["scale"]).reshape(shape)
-    raise ValueError(f"unknown encoding {enc!r}")
-
-
-def _read_exact(sock_file, n: int) -> Optional[bytes]:
-    chunks = []
-    while n > 0:
-        c = sock_file.read(n)
-        if not c:
-            return None
-        chunks.append(c)
-        n -= len(c)
-    return b"".join(chunks)
-
-
-def send_frame(sock_file, header: dict,
-               arrays: Optional[dict[str, np.ndarray]] = None,
-               fixed_bytes: int = 0, compress: bool = False) -> int:
-    """Write one frame; returns the number of payload+header bytes sent
-    (the wire-accounting unit PSClient reports)."""
-    metas, bufs = [], []
-    for name, a in (arrays or {}).items():
-        m, b = _encode(a, fixed_bytes, compress)
-        m["name"] = name
-        metas.append(m)
-        bufs.append(b)
-    header = dict(header, arrays=metas)
-    h = json.dumps(header).encode()
-    sock_file.write(struct.pack(">I", len(h)))
-    sock_file.write(h)
-    total = 4 + len(h)
-    for b in bufs:
-        sock_file.write(b)
-        total += len(b)
-    sock_file.flush()
-    return total
-
-
-def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
-    raw = _read_exact(sock_file, 4)
-    if raw is None:
-        return None
-    (hlen,) = struct.unpack(">I", raw)
-    h = _read_exact(sock_file, hlen)
-    if h is None:
-        return None
-    header = json.loads(h)
-    total = 4 + hlen
-    arrays = {}
-    for m in header.get("arrays", []):
-        buf = _read_exact(sock_file, m["nbytes"])
-        if buf is None:
-            return None
-        total += m["nbytes"]
-        arrays[m["name"]] = _decode(m, buf)
-    return header, arrays, total
 
 
 def shard_range(n: int, rank: int, world: int) -> tuple[int, int]:
@@ -201,13 +85,25 @@ def _idx_name(rows: int) -> str:
 # ---------------------------------------------------------------- server
 class _PSHandler(socketserver.StreamRequestHandler):
     def handle(self):
+        node = self.server.node  # type: ignore
+        with node._conns_lock:
+            node._conns.add(self.connection)
+        try:
+            self._serve(node)
+        finally:
+            with node._conns_lock:
+                node._conns.discard(self.connection)
+
+    def _serve(self, node):
         while True:
             got = recv_frame(self.rfile)
             if got is None:
                 return
             header, arrays, _ = got
-            resp_header, resp_arrays = self.server.node._dispatch(  # type: ignore
-                header, arrays)
+            resp_header, resp_arrays = node._dispatch(header, arrays)
+            # every reply carries the server's restore epoch so clients
+            # detect a respawned (rolled-back) server on any op
+            resp_header.setdefault("epoch", node.epoch)
             send_frame(self.wfile, resp_header, resp_arrays,
                        compress=bool(header.get("comp_reply")))
             if header.get("op") == "shutdown":
@@ -232,12 +128,23 @@ class ServerNode:
     in a per-row-space version array (`_ver[full_rows][row] = clock`).
     Tables with the same full row count form one group and share a
     version array — pushing z also makes the derived w's rows dirty,
-    which is exactly right since w = prox(z, n)."""
+    which is exactly right since w = prox(z, n).
+
+    Fault tolerance: pushes carrying a (`sender`, `seq`) pair are
+    seq-fenced — a seq at or below the sender's last applied one is
+    acknowledged but NOT re-applied, so clients may blindly replay their
+    push journal after a reconnect. `epoch` counts the process's
+    incarnations (0 = first run, N = Nth respawn); it rides every reply
+    so clients detect a restored-from-snapshot (rolled-back) server.
+    `start_snapshots` takes periodic async shard snapshots off the
+    request path; `restore_snapshot` rebuilds the shard from the newest
+    one (see docs/distributed.md "Fault tolerance")."""
 
     def __init__(self, rank: int, world: int,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, epoch: int = 0):
         self.rank = rank
         self.world = world
+        self.epoch = int(epoch)
         self.tables: dict[str, np.ndarray] = {}
         self.full_rows: dict[str, int] = {}  # full-table row counts
         # derived-table specs ({name: {"kind": "ftrl_prox", ...}}): tables
@@ -276,8 +183,22 @@ class ServerNode:
         self._zero_flags: Optional[dict[str, bool]] = None
         self._loaded = False
         self._stamped_all: set[int] = set()
+        # seq fence: last applied push sequence number per sender, the
+        # dedup table that makes client-side replay idempotent
+        self._last_seq: dict[str, int] = {}
+        # async snapshot state: base path, cadence, clock of the last
+        # written snapshot (skip when nothing changed), writer thread
+        self._snap_base: Optional[str] = None
+        self._snap_every = 0.0
+        self._snap_clock = -1
+        self._snap_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        # live handler connections, severed on stop() so a stopped node
+        # looks like a dead process to its clients (not a half-open
+        # socket that strands them in recv)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._srv = _PSServer((host, port), _PSHandler)
         self._srv.node = self  # type: ignore
         self.num_push = 0
@@ -299,6 +220,17 @@ class ServerNode:
         self._shutdown.set()
         self._srv.shutdown()
         self._srv.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _shard_rows(self, group: int) -> int:
         lo, hi = shard_range(group, self.rank, self.world)
@@ -317,6 +249,16 @@ class ServerNode:
     # -- ops ----------------------------------------------------------------
     def _dispatch(self, header: dict, arrays: dict) -> tuple[dict, dict]:
         op = header.get("op")
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.server_op(op)
+        if op == "hello":
+            # reconnect handshake: tells the client this server's epoch
+            # (rollback detection) and the last push seq it applied for
+            # the asking sender (journal replay starts after it)
+            sender = header.get("sender")
+            with self._lock:
+                return ({"ok": True, "clock": self.clock,
+                         "last_seq": self._last_seq.get(sender, 0)}, {})
         if op == "init":
             with self._lock:
                 known = bool(self.tables)
@@ -465,6 +407,17 @@ class ServerNode:
                 return {"ok": True, "clock": self.clock}, out
         if op == "push":
             with self._lock:
+                # seq fence BEFORE the clock advance: a replayed push
+                # (client journal re-sent after a reconnect) must be
+                # acknowledged without re-applying the delta OR bumping
+                # the clock — at-most-once apply is what makes the
+                # client's blind replay safe
+                sender, seq = header.get("sender"), header.get("seq")
+                if sender is not None and seq is not None:
+                    if seq <= self._last_seq.get(sender, 0):
+                        return ({"ok": True, "clock": self.clock,
+                                 "dup": True}, {})
+                    self._last_seq[sender] = int(seq)
                 self.num_push += 1
                 self.clock += 1
                 # uint32 stamp wrap would silently freeze rows as
@@ -724,6 +677,108 @@ class ServerNode:
         atomic_savez(path, compressed=True, **tables)
         return path
 
+    # -- hot-restore snapshots ----------------------------------------------
+    def start_snapshots(self, base: str, every_sec: float) -> None:
+        """Write `snapshot()` to `<base>_part-<rank>.npz` every
+        `every_sec` seconds on a daemon thread — off the request path, so
+        the only request-visible cost is the brief copy under the lock."""
+        self._snap_base = base
+        self._snap_every = float(every_sec)
+        os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+
+        def loop():
+            while not self._shutdown.wait(self._snap_every):
+                try:
+                    self.snapshot()
+                except Exception as e:  # keep snapshotting best-effort
+                    print(f"[ps server {self.rank}] snapshot failed: {e}",
+                          flush=True)
+
+        self._snap_thread = threading.Thread(target=loop, daemon=True)
+        self._snap_thread.start()
+
+    def snapshot(self) -> Optional[str]:
+        """One epoch-stamped shard snapshot (atomic temp+rename write).
+        Unlike `_save` checkpoints this also captures the clock, the seq
+        fence, and the table metadata a respawned server needs to resume
+        MID-training without a worker re-init. Skips when no push landed
+        since the last snapshot or tables aren't fully created yet."""
+        from wormhole_tpu.utils.checkpoint import atomic_savez, part_name
+
+        with self._lock:
+            if (not self.tables or self._pending
+                    or self.clock == self._snap_clock):
+                return None
+            self._recompute_derived()
+            arrays = {k: v.copy() for k, v in self.tables.items()}
+            meta = {
+                "clock": self.clock,
+                "epoch": self.epoch,
+                "world": self.world,
+                "full_rows": self.full_rows,
+                "derived": self.derived,
+                "last_seq": self._last_seq,
+                "full_shapes": self._full_shapes,
+                "zero_flags": self._zero_flags,
+            }
+            clock = self.clock
+        arrays["__snap__"] = np.frombuffer(
+            json.dumps(meta).encode(), np.uint8).copy()
+        path = part_name(self._snap_base or "ps_snap", None,
+                         self.rank) + ".npz"
+        atomic_savez(path, compressed=True, **arrays)
+        self._snap_clock = clock
+        return path
+
+    def restore_snapshot(self, base: str) -> bool:
+        """Rebuild this shard from its snapshot file; returns False when
+        none exists (a server dying before its first snapshot restarts
+        empty and waits for worker init like a fresh process). The
+        restored clock is re-stamped onto every nonzero row so a worker
+        pulling with a pre-crash `since` below it receives every row the
+        snapshot knows (a superset of what it missed — over-delivery is
+        safe, under-delivery would desync the base mirror)."""
+        from wormhole_tpu.utils.checkpoint import part_name
+
+        self._snap_base = base
+        path = part_name(base, None, self.rank) + ".npz"
+        if not os.path.exists(path):
+            return False
+        got = dict(np.load(path))
+        meta = json.loads(bytes(got.pop("__snap__").tobytes()).decode())
+        with self._lock:
+            self.tables = {k: np.ascontiguousarray(v, np.float32)
+                           for k, v in got.items()}
+            self.full_rows = {k: int(n)
+                              for k, n in meta["full_rows"].items()}
+            self.derived = meta["derived"] or {}
+            self._last_seq = {k: int(v)
+                              for k, v in (meta["last_seq"] or {}).items()}
+            self._full_shapes = meta["full_shapes"]
+            self._zero_flags = meta["zero_flags"]
+            self._pending = set()
+            self._claims = {}
+            self._create_group_meta()
+            self.clock = int(meta["clock"])
+            self._snap_clock = self.clock
+            for g, ver in self._ver.items():
+                nz = None
+                for k, rows in self.full_rows.items():
+                    if rows != g:
+                        continue
+                    t_nz = self.tables[k] != 0
+                    if t_nz.ndim > 1:
+                        t_nz = t_nz.any(axis=tuple(range(1, t_nz.ndim)))
+                    nz = t_nz if nz is None else (nz | t_nz)
+                if nz is not None:
+                    ver[nz] = self.clock
+                self._reset_pushlog(g)
+            self._loaded = True
+            self._stamped_all = set()
+        print(f"[ps server {self.rank}] restored snapshot {path} "
+              f"(clock {self.clock}, epoch {self.epoch})", flush=True)
+        return True
+
 
 # ---------------------------------------------------------------- client
 class PSClient:
@@ -731,9 +786,26 @@ class PSClient:
     servers' row ranges, keeps one persistent connection per server.
     Tracks wire bytes (bytes_push / bytes_pull, both directions) so the
     sparse-wire claim — bytes/sync proportional to touched keys — is a
-    measured quantity, not an assumption."""
+    measured quantity, not an assumption.
 
-    def __init__(self, uris: list[str], connect_deadline: float = 30.0):
+    Recovery (all opt-in; the defaults reproduce the original fail-fast
+    behavior exactly): with `retry_deadline > 0` a failed RPC is retried
+    with backoff against a (possibly respawned) server instead of
+    raising. `sender` names this worker for the servers' seq fence —
+    every push is stamped with a per-server sequence number and journaled
+    (last `journal_len` pushes per server), so on reconnect the client
+    replays the journal entries the server's `hello` reports as
+    unapplied; the fence makes over-replay harmless. `resolver`, when
+    given, re-resolves the server URI list on each reconnect attempt (a
+    respawned server binds a NEW port and re-announces it through the
+    scheduler). A reply whose `epoch` exceeds the last seen one marks the
+    server rolled-back; the next pull_sparse turns into a since=0 re-pull
+    so the base mirror re-adopts the restored state."""
+
+    def __init__(self, uris: list[str], connect_deadline: float = 30.0,
+                 sender: Optional[str] = None, retry_deadline: float = 0.0,
+                 resolver: Optional[Callable[[], Optional[list[str]]]] = None,
+                 journal_len: int = 64):
         self.uris = list(uris)
         self.world = len(uris)
         self._socks: list[Optional[socket.socket]] = [None] * self.world
@@ -743,6 +815,18 @@ class PSClient:
         self.bytes_push = 0
         self.bytes_pull = 0
         self.bytes_init = 0
+        self.sender = sender
+        self.retry_deadline = float(retry_deadline)
+        self.resolver = resolver
+        # per-server push seq numbers + journal of recent pushes
+        # (seq, header, arrays, fixed_bytes, compress); journaled only
+        # when retry is enabled so the default path pays no copies
+        self._seq = [0] * self.world
+        self._journal: list = [collections.deque(maxlen=max(journal_len, 1))
+                               for _ in range(self.world)]
+        self._epochs: list[Optional[int]] = [None] * self.world
+        self._rolled_back = [False] * self.world
+        self.num_retries = 0
 
     def _file(self, r: int):
         if self._files[r] is None:
@@ -752,40 +836,153 @@ class PSClient:
             self._files[r] = s.makefile("rwb")
         return self._files[r]
 
+    def _attempt(self, r: int, header: dict, arrays, fixed_bytes: int,
+                 compress: bool) -> tuple[dict, dict, int, int]:
+        """One send/recv round against server r; OSError (including the
+        ConnectionResetError recv_frame's None maps to) means the
+        connection is dead."""
+        f = self._file(r)
+        sent = send_frame(f, header, arrays, fixed_bytes, compress)
+        got = recv_frame(f)
+        if got is None:
+            raise ConnectionResetError("connection closed mid-rpc")
+        h, arrs, received = got
+        return h, arrs, sent, received
+
+    def _note_epoch(self, r: int, h: dict) -> None:
+        ep = h.get("epoch")
+        if ep is None:
+            return
+        last = self._epochs[r]
+        if last is not None and ep > last:
+            # the server restarted and restored a snapshot: its state
+            # rolled back to the snapshot clock. Flag it so the next
+            # versioned pull re-adopts the full restored state.
+            self._rolled_back[r] = True
+            print(f"[ps-retry] server {r} epoch {last} -> {ep}: "
+                  "rolled back to its last snapshot; scheduling a "
+                  "full re-pull", flush=True)
+        self._epochs[r] = ep
+
     def _rpc(self, r: int, header: dict, arrays=None, fixed_bytes: int = 0,
              compress: bool = False):
-        f = self._file(r)
         if compress:
             header = dict(header, comp_reply=1)
         op_name = header.get("op", "?")
-        try:
-            sent = send_frame(f, header, arrays, fixed_bytes, compress)
-            got = recv_frame(f)
-        except OSError as e:
-            self.close(r)
-            raise ConnectionError(
-                f"ps server {self.uris[r]} unreachable during "
-                f"'{op_name}' ({e}) — the server process likely died; "
-                "the job must be restarted (resume from the last "
-                "_iter-K checkpoint)") from e
-        if got is None:
-            self.close(r)
-            raise ConnectionResetError(
-                f"ps server {self.uris[r]} closed the connection during "
-                f"'{op_name}' — the server process likely died; the job "
-                "must be restarted (resume from the last _iter-K "
-                "checkpoint)")
-        h, arrs, received = got
+        if (op_name == "push" and self.sender is not None
+                and "seq" not in header):
+            # stamp the fence ONCE per logical push (a retried replay
+            # reuses the stamp — that's what the dedup keys on)
+            self._seq[r] += 1
+            header = dict(header, sender=self.sender, seq=self._seq[r])
+        while True:
+            try:
+                h, arrs, sent, received = self._attempt(
+                    r, header, arrays, fixed_bytes, compress)
+                break
+            except OSError as e:
+                self.close(r)
+                if self.retry_deadline <= 0 or op_name == "shutdown":
+                    if isinstance(e, ConnectionResetError):
+                        raise ConnectionResetError(
+                            f"ps server {self.uris[r]} closed the "
+                            f"connection during '{op_name}' — the server "
+                            "process likely died; the job must be "
+                            "restarted (resume from the last _iter-K "
+                            "checkpoint)") from e
+                    raise ConnectionError(
+                        f"ps server {self.uris[r]} unreachable during "
+                        f"'{op_name}' ({e}) — the server process likely "
+                        "died; the job must be restarted (resume from "
+                        "the last _iter-K checkpoint)") from e
+                self._recover(r, op_name, e)
         if "error" in h:
             raise RuntimeError(f"ps server error: {h['error']}")
+        self._note_epoch(r, h)
         op = header.get("op")
         if op == "push":
             self.bytes_push += sent + received
+            if self.retry_deadline > 0 and self.sender is not None:
+                self._journal[r].append(
+                    (header["seq"], header, arrays, fixed_bytes, compress))
         elif op == "pull":
             self.bytes_pull += sent + received
         elif op in ("init", "init_spec", "init_arrays"):
             self.bytes_init += sent + received
         return h, arrs
+
+    def _recover(self, r: int, op_name: str, err: Exception) -> None:
+        """Reconnect to server r (re-resolving its URI when a resolver
+        is available), fence with `hello`, and replay unacked journaled
+        pushes. Raises with the resume guidance once `retry_deadline`
+        is exhausted."""
+        deadline = time.monotonic() + self.retry_deadline
+        backoff = 0.25
+        print(f"[ps-retry] server {r} ({self.uris[r]}) failed during "
+              f"'{op_name}' ({err}); retrying for up to "
+              f"{self.retry_deadline:.0f}s", flush=True)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"ps server {self.uris[r]} unreachable during "
+                    f"'{op_name}' and did not come back within "
+                    f"{self.retry_deadline:.0f}s — the job must be "
+                    "restarted (resume from the last _iter-K checkpoint)"
+                ) from err
+            time.sleep(min(backoff, max(remaining, 0.0)))
+            backoff = min(backoff * 2, 2.0)
+            try:
+                if self.resolver is not None:
+                    uris = self.resolver()
+                    if uris and len(uris) == self.world:
+                        self.uris = list(uris)
+                self.close(r)
+                host, port = self.uris[r].rsplit(":", 1)
+                s = connect_with_retry(
+                    (host, int(port)),
+                    deadline_s=min(2.0, max(remaining, 0.1)))
+                self._socks[r] = s
+                self._files[r] = s.makefile("rwb")
+                h, _, _, _ = self._attempt(
+                    r, {"op": "hello", "sender": self.sender}, None, 0,
+                    False)
+                self._note_epoch(r, h)
+                self.num_retries += 1
+                applied = int(h.get("last_seq", 0))
+                replay = [e for e in self._journal[r] if e[0] > applied]
+                # the RPC being retried is re-sent by _rpc after we
+                # return; when it is itself an unapplied push, don't
+                # count it lost
+                in_flight = int(op_name == "push" and self.sender is not None
+                                and self._seq[r] > applied)
+                if (self.sender is not None
+                        and self._seq[r] > applied + len(replay) + in_flight):
+                    # pushes older than the journal window were lost with
+                    # the dead server and cannot be replayed; the
+                    # snapshot bounds the loss — warn, don't die (the
+                    # merged model self-corrects like any bounded-
+                    # staleness overwrite)
+                    print(f"[ps-retry] server {r}: "
+                          f"{self._seq[r] - applied - len(replay)} "
+                          "pushes predate the journal window and are "
+                          "lost to the rollback", flush=True)
+                for seq, hdr, arrs, fb, comp in replay:
+                    rh, _, _, _ = self._attempt(r, hdr, arrs, fb, comp)
+                    if "error" in rh:
+                        raise RuntimeError(
+                            f"ps server error on replay: {rh['error']}")
+                if replay:
+                    print(f"[ps-retry] server {r}: replayed "
+                          f"{len(replay)} journaled pushes "
+                          f"(server had seq {applied})", flush=True)
+                print(f"[ps-retry] server {r} reconnected at "
+                      f"{self.uris[r]} (epoch {self._epochs[r]})",
+                      flush=True)
+                return
+            except (OSError, ConnectionError) as e2:
+                self.close(r)
+                err = e2
 
     def close(self, r: Optional[int] = None) -> None:
         ranks = range(self.world) if r is None else [r]
@@ -877,7 +1074,16 @@ class PSClient:
         g_idx: dict[int, list] = {}
         t_rows: dict[str, list] = {}
         for r in range(self.world):
-            h, arrs = self._rpc(r, {"op": "pull", "since": int(since[r])},
+            s = int(since[r])
+            if self._rolled_back[r]:
+                # the server restored a snapshot: its clock (and row
+                # stamps) rolled back, so our `since` may exceed it and
+                # miss rows. since=0 returns every stamped row — a
+                # superset of the delta — and re-adopts the restored
+                # state wholesale.
+                self._rolled_back[r] = False
+                s = 0
+            h, arrs = self._rpc(r, {"op": "pull", "since": s},
                                 compress=compress)
             clocks.append(int(h["clock"]))
             for g in {rows for rows in self.full_rows.values()}:

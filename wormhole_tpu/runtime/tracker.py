@@ -33,6 +33,7 @@ import time
 from enum import Enum
 from typing import Optional
 
+from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime.net import connect_with_retry
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
@@ -114,6 +115,7 @@ class Scheduler:
         self._shutdown = False                   # job end; workers exit
         self._seen_workers: set[str] = set()     # workers ever registered
         self._blobs: dict[str, str] = {}         # rendezvous KV payloads
+        self.num_server_recoveries = 0           # servers that re-registered
         self._done = False
         self._srv = _Server((host, port), _Handler)
         self._srv.scheduler = self  # type: ignore
@@ -264,6 +266,8 @@ class Scheduler:
     # -- RPC ops ------------------------------------------------------------
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.sched_op(op)
         node = req.get("node", "?")
         with self._lock:
             self._nodes[node] = time.monotonic()
@@ -273,9 +277,21 @@ class Scheduler:
             return {"ok": True, "epoch": self._epoch}
         if op == "register_server":
             # a ps server announces its push/pull endpoint (the ps-lite
-            # node-manager rendezvous role)
+            # node-manager rendezvous role). A rank re-registering under
+            # a NEW uri is a respawned server rejoining — a first-class
+            # recovery event: log it and count it into progress so the
+            # job's output records that a failover happened.
             with self._lock:
-                self._server_uris[int(req["rank"])] = req["uri"]
+                rank = int(req["rank"])
+                prev = self._server_uris.get(rank)
+                self._server_uris[rank] = req["uri"]
+                recovered = prev is not None and prev != req["uri"]
+                if recovered:
+                    self.num_server_recoveries += 1
+                    self.progress.merge({"server_recoveries": 1.0})
+            if recovered:
+                print(f"[recovery] ps server-{rank} re-registered at "
+                      f"{req['uri']} (was {prev})", flush=True)
             return {"ok": True}
         if op == "servers":
             # workers poll until the full `-s` group is up
@@ -415,6 +431,16 @@ class Scheduler:
                 for n in dead:
                     del self._nodes[n]
             for n in dead:
+                if n.startswith("server"):
+                    # servers carry no pool parts; their loss is its own
+                    # first-class event (the launcher's respawn loop — if
+                    # enabled — brings the process back; workers ride it
+                    # out through the PSClient retry path)
+                    print(f"[recovery] ps {n} lost from the liveness "
+                          "plane (no epoch ping for "
+                          f"{self.node_timeout:.0f}s); awaiting respawn "
+                          "or worker-side retry failure", flush=True)
+                    continue
                 requeued = self.pool.reset(n)
                 if requeued:
                     print(f"node {n} lost; re-queued {requeued} parts",
