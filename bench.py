@@ -80,10 +80,13 @@ def synth_criteo_batch(rng, minibatch, num_buckets=None):
     return seg, idx, val, label, mask
 
 
-def emit(metric, value, unit, vs_baseline=None):
+def emit(metric, value, unit, vs_baseline=None, **extra):
+    """One BENCH JSON line; keyword extras (e.g. an `obs` telemetry
+    snapshot) ride along as additional row fields."""
     row = {"metric": metric, "value": round(value, 1), "unit": unit,
            "vs_baseline": (round(vs_baseline, 3)
                            if vs_baseline is not None else None)}
+    row.update({k: v for k, v in extra.items() if v is not None})
     print(json.dumps(row), flush=True)
     return row
 
@@ -271,14 +274,15 @@ print_sec = 3600
         env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
         env.pop("JAX_PLATFORM_NAME", None)
 
-        def run_group(argv, timeout):
+        def run_group(argv, timeout, extra_env=None):
             """subprocess.run with whole-process-group kill on timeout:
             run()'s own timeout kills only the direct child, leaking the
             launcher's role processes to compete with every later bench
             config (observed after the r3 timeout)."""
+            e = dict(env, **extra_env) if extra_env else env
             p = subprocess.Popen(argv, stdout=subprocess.PIPE,
                                  stderr=subprocess.PIPE, text=True,
-                                 env=env, cwd=repo, start_new_session=True)
+                                 env=e, cwd=repo, start_new_session=True)
             try:
                 out, err = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
@@ -288,17 +292,31 @@ print_sec = 3600
             return types.SimpleNamespace(returncode=p.returncode,
                                          stdout=out, stderr=err)
 
+        # the distributed run also records its obs telemetry so the
+        # BENCH row carries wire volume + RPC quantiles alongside the
+        # throughput (run_report.json, wormhole_tpu/obs/report.py)
+        obs_dir = f"{td}/obs_dist"
         r = run_group(
             [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
              "-n", "1", "-s", "1", "--",
              sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
-            timeout=600)
+            timeout=600, extra_env={"WH_OBS_DIR": obs_dir})
         assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
         m = re.search(r"\[ps-wire\] (\{.*\})", r.stdout)
         assert m, r.stdout[-2000:]
         wire = json.loads(m.group(1))
         dist_eps = wire["last_round_nex"] / max(wire["last_round_sec"],
                                                 1e-9)
+        obs = None
+        try:
+            with open(f"{obs_dir}/run_report.json") as fh:
+                s = json.load(fh)["summary"]
+            obs = {k: s.get(k) for k in (
+                "num_push", "num_pull", "bytes_pushed", "bytes_pulled",
+                "net_bytes_sent", "net_bytes_recv",
+                "rpc_p50_ms", "rpc_p99_ms")}
+        except (OSError, KeyError, json.JSONDecodeError):
+            pass  # telemetry riding along must not fail the bench
 
         r1 = run_group(
             [sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
@@ -311,7 +329,7 @@ print_sec = 3600
 
     # dense wire at this operating point: push z+n deltas, pull w+z+n
     dense_bytes = 5 * num_buckets * 4
-    return dist_eps, single_eps, wire, dense_bytes
+    return dist_eps, single_eps, wire, dense_bytes, obs
 
 
 # ---------------------------------------------------------------- kmeans
@@ -448,7 +466,7 @@ def main():
              eps, "examples/sec", eps / BASELINE_EXAMPLES_PER_SEC)
     got = _safe("linear_ps", bench_linear_ps)
     if got is not None:
-        dist_eps, single_eps, wire, dense_bytes = got
+        dist_eps, single_eps, wire, dense_bytes, obs = got
         # vs_baseline here = ratio to the single-process run on the same
         # data/platform. On this 1-core box the ratio is dominated by
         # worker/server/scheduler timesharing of the core: the
@@ -456,7 +474,7 @@ def main():
         # (~7% overhead) measured in-process — see PERF.md "PS plane"
         # for the full attribution (r4's >= 0.77 bar conflated the two)
         emit("linear_ftrl_ps_dist_64m_buckets_examples_per_sec", dist_eps,
-             "examples/sec", dist_eps / single_eps)
+             "examples/sec", dist_eps / single_eps, obs=obs)
         # vs_baseline = fraction of what a dense-table sync would move
         emit("ps_wire_bytes_per_sync_64m_buckets", wire["bytes_per_sync"],
              "bytes", wire["bytes_per_sync"] / dense_bytes)

@@ -11,6 +11,23 @@ Three verdicts per scenario:
                      the worst outcome: the job "passed" while the
                      recovery path lost or double-applied state
 
+On top of the logloss check, every run executes with WH_OBS_DIR set and
+its run_report.json feeds the verdict (wormhole_tpu/obs):
+
+  - a server-kill scenario that "survived" must actually show the
+    recovery in its metrics (server restores / scheduler-registered
+    recoveries / ps retries) — a clean logloss with no recovery
+    observed means the fault was absorbed by accident, not by design;
+  - a connection-reset scenario (no server death, so no state was
+    lost) must show journal_replays == replay_dedup_hits: every
+    replayed push dup-acked by the seq fence. An un-deduped replay is
+    a double-applied gradient — flagged SILENT-CORRUPTION even when
+    the logloss happens to land within --tol.
+
+The matrix also prints each scenario's metric deltas vs the unfaulted
+baseline (retries, replays, dedups, restores) so a recovery-path
+regression shows up as numbers, not vibes.
+
 The default matrix exercises every recovery layer: a server killed
 mid-push (snapshot restore + journal replay), a server killed mid-pull
 (rollback detection -> since=0 re-pull), a worker-side connection reset
@@ -30,6 +47,7 @@ SIGKILL-shaped hole tests/test_apps.py's chaos tests punch.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import re
 import subprocess
@@ -69,12 +87,18 @@ def synth_libsvm(path: str, n_rows: int, seed: int, n_feat: int = 1000,
 
 
 def run_job(conf: str, spec: str, workers: int, servers: int,
-            restarts: int, timeout: float) -> tuple[int, str, float]:
+            restarts: int, timeout: float,
+            obs_dir: str | None = None
+            ) -> tuple[int, str, float, dict | None]:
     env = dict(os.environ, PYTHONPATH=REPO)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("WH_FAULT_SPEC", None)
+    env.pop("WH_OBS_DIR", None)
     if spec:
         env["WH_FAULT_SPEC"] = spec
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        env["WH_OBS_DIR"] = obs_dir
     t0 = time.monotonic()
     r = subprocess.run(
         [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
@@ -83,12 +107,35 @@ def run_job(conf: str, spec: str, workers: int, servers: int,
          "--max-server-restarts", str(restarts), "--",
          sys.executable, "-m", "wormhole_tpu.apps.difacto", conf],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
-    return r.returncode, r.stdout + r.stderr, time.monotonic() - t0
+    report = None
+    if obs_dir:
+        path = os.path.join(obs_dir, "run_report.json")
+        try:
+            with open(path) as fh:
+                report = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            pass  # a crashed run may not get as far as the report
+    return r.returncode, r.stdout + r.stderr, time.monotonic() - t0, report
 
 
 def final_logloss(out: str) -> float | None:
     m = re.search(r"final val: logloss=([0-9.]+)", out)
     return float(m.group(1)) if m else None
+
+
+# run_report.json summary keys the matrix compares across scenarios
+_METRIC_KEYS = ("ps_retries", "journal_replays", "replay_dedup_hits",
+                "server_restores", "server_recoveries", "connect_retries")
+
+
+def report_metrics(report: dict | None) -> dict[str, int]:
+    s = (report or {}).get("summary") or {}
+    return {k: int(s.get(k, 0)) for k in _METRIC_KEYS}
+
+
+def metric_deltas(m: dict[str, int], base: dict[str, int]) -> str:
+    return " ".join(f"Δ{k}={m[k] - base[k]:+d}" for k in _METRIC_KEYS
+                    if m[k] - base[k] != 0) or "Δ(none)"
 
 
 def main(argv=None) -> int:
@@ -142,21 +189,31 @@ max_delay = 1
     print(f"[chaos] scratch={scratch} workers={args.workers} "
           f"servers={args.servers} max_server_restarts={restarts}")
 
-    rc, out, dt = run_job(conf, "", args.workers, args.servers,
-                          restarts, args.timeout)
+    rc, out, dt, base_report = run_job(
+        conf, "", args.workers, args.servers, restarts, args.timeout,
+        obs_dir=os.path.join(scratch, "obs-baseline"))
     base = final_logloss(out)
     if rc != 0 or base is None:
         print(out[-4000:])
         print(f"[chaos] baseline (no fault) FAILED rc={rc} — nothing to "
               "compare against; fix the clean path first")
         return 2
-    print(f"[chaos] baseline: logloss={base:.5f} ({dt:.0f}s)")
+    base_m = report_metrics(base_report)
+    if base_report is None:
+        print("[chaos] WARNING: baseline wrote no run_report.json — "
+              "metric verdicts degraded to log-scraping only")
+    print(f"[chaos] baseline: logloss={base:.5f} ({dt:.0f}s) "
+          f"retries={base_m['ps_retries']} "
+          f"replays={base_m['journal_replays']}")
 
     rows, worst = [], 0
-    for spec in args.specs:
-        rc, out, dt = run_job(conf, spec, args.workers, args.servers,
-                              restarts, args.timeout)
+    for i, spec in enumerate(args.specs):
+        rc, out, dt, report = run_job(
+            conf, spec, args.workers, args.servers, restarts,
+            args.timeout, obs_dir=os.path.join(scratch, f"obs-{i}"))
         ll = final_logloss(out)
+        m = report_metrics(report)
+        undeduped = m["journal_replays"] - m["replay_dedup_hits"]
         if rc != 0 or ll is None:
             verdict, detail = "FAILED", f"rc={rc} logloss={ll}"
             worst = max(worst, 1)
@@ -165,6 +222,17 @@ max_delay = 1
         elif abs(ll - base) > args.tol:
             verdict = "SILENT-CORRUPTION"
             detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            worst = max(worst, 3)
+        elif report is not None and spec.startswith("net:") \
+                and "reset" in spec and undeduped > 0:
+            # no server died, so no journal entry was legitimately
+            # re-applied: a replay the seq fence did NOT dup-ack is a
+            # double-applied gradient, whatever the logloss says
+            verdict = "SILENT-CORRUPTION"
+            detail = (f"logloss={ll:.5f} but {undeduped} replayed "
+                      f"pushes were NOT dup-acked "
+                      f"(replays={m['journal_replays']} "
+                      f"dedup={m['replay_dedup_hits']})")
             worst = max(worst, 3)
         else:
             verdict = "survived"
@@ -176,18 +244,29 @@ max_delay = 1
                     and not re.search(r"\[faults\] (injecting|server rank)",
                                       out):
                 verdict = "survived (fault never fired!)"
+            elif report is not None and "kill" in spec and not (
+                    m["server_restores"] or m["server_recoveries"]
+                    or m["ps_retries"]):
+                # the kill fired and the job passed, yet no recovery
+                # machinery reported doing anything — the survival is
+                # luck (e.g. the server died after its last useful op)
+                verdict = "survived (no recovery observed!)"
         recov = len(re.findall(r"respawning with restore epoch", out))
         retries = len(re.findall(r"\[ps-retry\]", out))
-        rows.append((spec, verdict, detail, recov, retries, dt))
+        deltas = metric_deltas(m, base_m) if report is not None \
+            else "(no run_report.json)"
+        rows.append((spec, verdict, detail, recov, retries, dt, deltas))
         print(f"[chaos] {spec}: {verdict} ({detail.splitlines()[0]}, "
               f"{recov} respawns, {retries} retry events, {dt:.0f}s)")
+        print(f"[chaos]   metrics vs baseline: {deltas}")
 
     print(f"\n{'spec':<34} {'verdict':<18} {'respawns':>8} "
           f"{'retries':>8} {'sec':>5}")
-    for spec, verdict, detail, recov, retries, dt in rows:
+    for spec, verdict, detail, recov, retries, dt, deltas in rows:
         print(f"{spec:<34} {verdict:<18} {recov:>8} {retries:>8} "
               f"{dt:>5.0f}")
         print(f"    {detail.splitlines()[0]}")
+        print(f"    {deltas}")
     if not args.keep:
         import shutil
 

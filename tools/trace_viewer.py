@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Merge per-node obs trace JSONL into one Chrome-trace JSON.
+
+Every process of a run with WH_OBS_DIR set appends spans/events to its
+own `trace-<node>-<pid>.jsonl` (wormhole_tpu/obs/trace.py). Timestamps
+in those files are per-process *monotonic* seconds — immune to NTP
+steps but meaningless across processes. Each file's first line is a
+clock anchor `{"ph": "M", "wall": ..., "mono": ...}` pairing one
+monotonic reading with wall time; this tool uses it to place every
+file on a shared wall-clock axis and emits the Chrome trace event
+format:
+
+    python tools/trace_viewer.py /path/to/obs_dir [-o trace.json]
+
+Open the output in https://ui.perfetto.dev or chrome://tracing. Each
+process incarnation becomes a Chrome "process" named `<node>/<pid>`
+(a respawned server shows up as a second lane next to its dead
+predecessor), threads keep the small integer tids the tracer assigned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_trace_file(path: str) -> tuple[dict | None, list[dict]]:
+    """Read one JSONL trace file -> (anchor, records). Tolerates a
+    truncated final line (crash mid-write loses at most that line)."""
+    anchor = None
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write
+            if rec.get("ph") == "M" and anchor is None:
+                anchor = rec
+            else:
+                records.append(rec)
+    return anchor, records
+
+
+def merge_traces(paths: list[str]) -> dict:
+    """Merge trace JSONL files into a Chrome trace dict
+    (`{"traceEvents": [...], "displayTimeUnit": "ms"}`). Files without
+    a clock anchor are skipped (nothing to align them with)."""
+    loaded = []
+    for p in sorted(paths):
+        anchor, records = load_trace_file(p)
+        if anchor is None:
+            print(f"[trace_viewer] skipping {p}: no clock anchor",
+                  file=sys.stderr)
+            continue
+        loaded.append((anchor, records))
+    if not loaded:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    # wall time of a record: anchor.wall + (ts - anchor.mono)
+    t0 = min(
+        a["wall"] + (min((r["ts"] for r in recs), default=a["mono"])
+                     - a["mono"])
+        for a, recs in loaded
+    )
+    events = []
+    run_ids = set()
+    for pid_num, (anchor, records) in enumerate(
+            sorted(loaded, key=lambda ar: (ar[0].get("node", ""),
+                                           ar[0].get("pid", 0)))):
+        run_ids.add(anchor.get("run"))
+        name = f"{anchor.get('node', '?')}/{anchor.get('pid', '?')}"
+        events.append({"ph": "M", "name": "process_name", "pid": pid_num,
+                       "tid": 0, "args": {"name": name}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": pid_num, "tid": 0,
+                       "args": {"sort_index": pid_num}})
+        off = anchor["wall"] - anchor["mono"] - t0  # mono s -> rel wall s
+        for r in records:
+            ev = {
+                "ph": r.get("ph", "X"),
+                "name": r.get("name", "?"),
+                "cat": r.get("cat", "span"),
+                "pid": pid_num,
+                "tid": r.get("tid", 0),
+                "ts": (r["ts"] + off) * 1e6,  # Chrome wants microseconds
+            }
+            if ev["ph"] == "X":
+                ev["dur"] = r.get("dur", 0.0) * 1e6
+            elif ev["ph"] == "i":
+                ev["s"] = "t"  # thread-scoped instant
+            if r.get("args"):
+                ev["args"] = r["args"]
+            events.append(ev)
+    events.sort(key=lambda e: (e.get("ts", 0), e["pid"], e["tid"]))
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    run_ids.discard(None)
+    if run_ids:
+        out["metadata"] = {"run_ids": sorted(run_ids)}
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_viewer",
+        description="merge WH_OBS_DIR trace-*.jsonl into Chrome trace JSON")
+    ap.add_argument("obs_dir",
+                    help="directory the run wrote its trace files to "
+                         "(the WH_OBS_DIR of the run)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: <obs_dir>/trace.json)")
+    args = ap.parse_args(argv)
+    paths = glob.glob(os.path.join(args.obs_dir, "trace-*.jsonl"))
+    if not paths:
+        print(f"[trace_viewer] no trace-*.jsonl under {args.obs_dir}",
+              file=sys.stderr)
+        return 1
+    merged = merge_traces(paths)
+    out = args.out or os.path.join(args.obs_dir, "trace.json")
+    with open(out, "w") as fh:
+        json.dump(merged, fh)
+    n = sum(1 for e in merged["traceEvents"] if e["ph"] != "M")
+    print(f"[trace_viewer] {len(paths)} files, {n} events -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,9 @@ import sys
 import time
 
 from wormhole_tpu.config import load_config
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import report as _report
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime.ps_server import PSClient, ServerNode, SyncedStore
 from wormhole_tpu.runtime.tracker import (
     RemotePool, Scheduler, SchedulerClient, node_env,
@@ -433,11 +436,43 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
                       "check -n and the worker logs)", flush=True)
                 break
             time.sleep(0.2)
+        # end-of-run telemetry: per-server push/pull truth straight from
+        # the (still-alive) servers, then the aggregated report — AFTER
+        # the drain so the final snapshots workers piggybacked on their
+        # `bye` are in, BEFORE shutdown while the stats op still answers
+        ps_stats = None
         if ps is not None:
+            try:
+                ps_stats = {r: ps.stats(r) for r in range(ps.world)}
+            except Exception as e:
+                print(f"[obs] ps stats unavailable at shutdown: {e}",
+                      flush=True)
             ps.shutdown()
+        _emit_run_report(sched, ps_stats, verbose)
         return result
     finally:
         sched.stop()
+
+
+def _emit_run_report(sched: Scheduler, ps_stats, verbose: bool) -> None:
+    """Build the end-of-run report from the scheduler's aggregated
+    metrics, print the human summary plus the `[run-report]` machine
+    line (the launcher scrapes it), and write run_report.json when
+    WH_OBS_DIR is set. Telemetry must never fail the job."""
+    try:
+        agg = sched.aggregate_metrics()
+        report = _report.build(agg["aggregate"], nodes=agg["nodes"],
+                               ps_stats=ps_stats)
+        if verbose:
+            for line in _report.format_lines(report):
+                print(line, flush=True)
+        print(_report.machine_line(report), flush=True)
+        if _report.enabled():
+            path = _report.write(report)
+            if verbose:
+                print(f"[obs] run report written: {path}", flush=True)
+    except Exception as e:
+        print(f"[obs] run report failed: {e}", flush=True)
 
 
 def _server_uris(sched: Scheduler) -> list[str]:
@@ -471,7 +506,9 @@ def _run_server(cfg, env) -> dict:
                                    or 5.0))
     try:
         while not node.wait_shutdown(2.0):
-            client.call(op="epoch")  # liveness ping
+            # liveness ping, carrying this incarnation's metrics
+            # snapshot for the scheduler's aggregation
+            client.call(op="epoch", metrics=_obs.REGISTRY.snapshot())
     finally:
         node.stop()
     return {}
@@ -498,7 +535,9 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
     # eviction is what re-queues its in-flight parts (a bye from a
     # crash path would silently disable the failure recovery).
     try:
-        client.call(op="bye")
+        # the bye carries this worker's FINAL metrics snapshot — the
+        # pinger's last periodic one may predate the tail work
+        client.call(op="bye", metrics=_obs.REGISTRY.snapshot())
     except Exception:
         pass
     return result
@@ -634,23 +673,27 @@ def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass,
     prog = Progress()
     train = wtype == WorkType.TRAIN
     step = learner.train_batch if train else learner.eval_batch
+    span_name = "train_step" if train else "eval_step"
     while (got := pool.get()) is not None:
         part_id, f = got
         part_prog: dict = {}
-        for blk in MinibatchIter(
-            f.filename, f.part, f.num_parts, f.format,
-            minibatch_size=cfg.minibatch,
-            shuf_buf=(cfg.rand_shuffle * cfg.minibatch if train else 0),
-            neg_sampling=(cfg.neg_sampling if train else 1.0),
-            seed=data_pass * 7919 + part_id,
-        ):
-            p = step(blk)
-            for k, v in p.items():
-                part_prog[k] = part_prog.get(k, 0.0) + float(v)
+        with _trace.span("part", cat="solver", part=part_id,
+                         data_pass=data_pass):
+            for blk in MinibatchIter(
+                f.filename, f.part, f.num_parts, f.format,
+                minibatch_size=cfg.minibatch,
+                shuf_buf=(cfg.rand_shuffle * cfg.minibatch if train else 0),
+                neg_sampling=(cfg.neg_sampling if train else 1.0),
+                seed=data_pass * 7919 + part_id,
+            ):
+                with _trace.span(span_name, cat="solver"):
+                    p = step(blk)
+                for k, v in p.items():
+                    part_prog[k] = part_prog.get(k, 0.0) + float(v)
+                if train and synced is not None:
+                    synced.maybe_sync()
             if train and synced is not None:
-                synced.maybe_sync()
-        if train and synced is not None:
-            synced.sync()
+                synced.sync()
         prog.merge(part_prog)
         pool.finish(part_id, part_prog)
     return prog

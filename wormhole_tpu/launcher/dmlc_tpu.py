@@ -34,6 +34,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shlex
 import signal
@@ -41,6 +42,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 
 def _free_port() -> int:
@@ -68,10 +70,15 @@ def _default_host_ip() -> str | None:
     return None
 
 
-def _stream(prefix: str, pipe, out):
+def _stream(prefix: str, pipe, out, on_line=None):
     for line in iter(pipe.readline, b""):
         out.write(f"[{prefix}] ".encode() + line)
         out.flush()
+        if on_line is not None:
+            try:
+                on_line(line)
+            except Exception:
+                pass  # a watcher bug must never break output streaming
 
 
 def launch(num_workers: int, num_servers: int, cmd: list[str],
@@ -125,6 +132,10 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     else:
         sched_host = "127.0.0.1"
     uri = f"{sched_host}:{_free_port()}"
+    # one run id for the whole job so every node's trace spans and the
+    # final report carry the same tag (obs/trace.py reads WH_RUN_ID)
+    run_id = os.environ.get("WH_RUN_ID") or f"wh-{int(time.time())}-{os.getpid()}"
+    obs_dir = os.environ.get("WH_OBS_DIR")
     # jax.distributed rendezvous for apps that opt into the global-mesh
     # mode (parallel/multihost.py); worker 0 binds it on first use. On a
     # pod, worker 0 lives on hosts[0]; coord_port must be free THERE, so
@@ -143,7 +154,13 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             WH_SCHEDULER_URI=uri,
             WH_COORD_URI=coord_uri,
             WH_NODE_TIMEOUT=str(node_timeout),
+            WH_RUN_ID=run_id,
         )
+        if obs_dir:
+            # remote spawns don't inherit the launch-host environment;
+            # exporting it in the contract keeps telemetry on for them
+            # too (each node appends to its host-local WH_OBS_DIR)
+            env["WH_OBS_DIR"] = obs_dir
         if snapshot_dir:
             env["WH_SNAPSHOT_DIR"] = snapshot_dir
         if recovery and not os.environ.get("WH_PS_RETRY_SEC"):
@@ -194,15 +211,38 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     procs.update({f"worker-{r}": p for r, p in enumerate(workers)})
     threads = []
 
-    def watch_output(name: str, p: subprocess.Popen) -> None:
+    def scrape_report(line: bytes) -> None:
+        """Scheduler stdout watcher: the scheduler prints the aggregated
+        run report as a machine line (`[run-report] {json}`); persist it
+        when the scheduler process couldn't (e.g. its WH_OBS_DIR is on
+        another filesystem view). Written only when the file is absent —
+        the scheduler's own write wins when both see the same dir."""
+        marker = b"[run-report] "
+        if not obs_dir or not line.startswith(marker):
+            return
+        path = os.path.join(obs_dir, "run_report.json")
+        if os.path.exists(path):
+            return
+        report = json.loads(line[len(marker):].decode())
+        os.makedirs(obs_dir, exist_ok=True)
+        tmp = f"{path}.launcher.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, path)
+
+    def watch_output(name: str, p: subprocess.Popen,
+                     on_line=None) -> None:
         t = threading.Thread(target=_stream,
-                             args=(name, p.stdout, sys.stdout.buffer),
+                             args=(name, p.stdout, sys.stdout.buffer,
+                                   on_line),
                              daemon=True)
         t.start()
         threads.append(t)
 
     for name, p in procs.items():
-        watch_output(name, p)
+        watch_output(name, p,
+                     on_line=scrape_report if name == "scheduler" else None)
 
     stop_respawn = threading.Event()
 

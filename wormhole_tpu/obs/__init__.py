@@ -1,0 +1,27 @@
+"""Unified telemetry for wormhole-tpu.
+
+Three layers, each usable alone (see docs/observability.md):
+
+- `obs.metrics` — a process-wide registry of counters, gauges and
+  bounded-reservoir histograms. Always on (an increment is a lock and
+  an add); hot paths cache metric handles at module import so the
+  per-event cost is constant and allocation-free.
+- `obs.trace` — distributed trace spans/events as append-only JSONL,
+  one file per node incarnation, opt-in via WH_OBS_DIR. Disabled it is
+  a single module-level None check (the same contract as
+  runtime/faults.py). `tools/trace_viewer.py` merges the per-node
+  files into one Chrome-trace/Perfetto JSON.
+- `obs.report` — the end-of-run report: the scheduler aggregates the
+  metric snapshots nodes piggyback on their heartbeats, prints a
+  summary, and `run_report.json` lands in WH_OBS_DIR (written by the
+  launcher from the scheduler's `[run-report]` line, or directly by a
+  single-process solver).
+
+This package is imported by the runtime/solver modules that use it —
+never by `wormhole_tpu/__init__.py` — so `import wormhole_tpu` alone
+loads none of it (tests/test_obs.py pins that).
+"""
+
+from wormhole_tpu.obs import metrics, report, trace  # noqa: F401
+
+REGISTRY = metrics.REGISTRY

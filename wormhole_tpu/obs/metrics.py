@@ -1,0 +1,261 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Design constraints (ISSUE 2):
+
+- thread-safe: every instrument carries its own lock; the registry
+  lock is only taken on get-or-create, so hot paths that cache their
+  handles at module import never touch it again;
+- near-zero cost when unused: an increment is one lock acquire and an
+  integer add — no allocation, no string formatting, no I/O. Nothing
+  here ever writes a file or opens a socket; export happens only when
+  someone calls `snapshot()` (heartbeat piggyback, end-of-run report);
+- bounded memory: histograms keep `count/sum/min/max` exactly plus a
+  fixed-size reservoir (Vitter's algorithm R with a per-name seeded
+  PRNG, so snapshots are deterministic under single-threaded use) from
+  which quantiles are estimated. A histogram never grows past
+  `reservoir` samples no matter how many observations it absorbs.
+
+Snapshots are plain JSON-able dicts so they can ride the newline-JSON
+scheduler channel unchanged:
+
+    {"counters": {name: int}, "gauges": {name: float},
+     "hists": {name: {"count": n, "sum": s, "min": lo, "max": hi,
+                      "res": [float, ...]}}}
+
+`merge_snapshots` folds any number of such dicts into one (counters
+sum, gauges take the max, histograms merge moments and pool+downsample
+reservoirs) — that is what the scheduler does with the per-node
+snapshots nodes piggyback on their heartbeats.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+import zlib
+
+DEFAULT_RESERVOIR = 256
+
+
+class Counter:
+    """Monotonic integer counter."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-written value (restore epoch, queue depth, ...)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Exact moments + a bounded reservoir for quantile estimates."""
+
+    __slots__ = ("name", "reservoir", "count", "sum", "min", "max",
+                 "_res", "_rng", "_lock")
+
+    def __init__(self, name: str, reservoir: int = DEFAULT_RESERVOIR):
+        self.name = name
+        self.reservoir = int(reservoir)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._res: list[float] = []
+        # deterministic per-name stream keeps single-threaded snapshots
+        # reproducible without sharing one global PRNG (and its lock)
+        self._rng = random.Random(zlib.crc32(name.encode()))
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            if len(self._res) < self.reservoir:
+                self._res.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.reservoir:
+                    self._res[j] = v
+
+    def quantile(self, q: float) -> float | None:
+        with self._lock:
+            res = sorted(self._res)
+        return _quantile_sorted(res, q)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"count": self.count, "sum": self.sum,
+                    "min": self.min, "max": self.max,
+                    "res": list(self._res)}
+
+
+def _quantile_sorted(res: list[float], q: float) -> float | None:
+    if not res:
+        return None
+    q = min(1.0, max(0.0, float(q)))
+    return res[min(len(res) - 1, int(q * len(res)))]
+
+
+class Registry:
+    """Get-or-create home for named instruments."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str,
+                  reservoir: int = DEFAULT_RESERVOIR) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name, reservoir)
+            return h
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        """Time a block into histogram `name` (seconds)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.histogram(name).observe(time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._hists.values())
+        return {
+            "counters": {c.name: c.value() for c in counters},
+            "gauges": {g.name: g.value() for g in gauges},
+            "hists": {h.name: h.snapshot() for h in hists},
+        }
+
+    def reset(self) -> None:
+        """Drop every instrument (tests and respawned incarnations).
+
+        Cached handles in already-imported modules keep working but
+        stop being visible in snapshots; hot-path modules therefore
+        re-fetch handles lazily or tolerate this (tests only reset
+        between logical runs, never mid-run).
+        """
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+#: The process-wide registry. Hot paths do
+#:   _FRAMES = REGISTRY.counter("net.frames_sent")
+#: once at import and call `_FRAMES.inc()` per event.
+REGISTRY = Registry()
+
+
+def merge_snapshots(snaps, reservoir: int = DEFAULT_RESERVOIR) -> dict:
+    """Fold snapshot dicts into one: counters sum, gauges max,
+    histogram moments merge and reservoirs pool then downsample."""
+    counters: dict[str, int] = {}
+    gauges: dict[str, float] = {}
+    hists: dict[str, dict] = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for k, v in (snap.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for k, v in (snap.get("gauges") or {}).items():
+            gauges[k] = max(gauges.get(k, float(v)), float(v))
+        for k, h in (snap.get("hists") or {}).items():
+            if not isinstance(h, dict):
+                continue
+            m = hists.get(k)
+            if m is None:
+                m = hists[k] = {"count": 0, "sum": 0.0,
+                                "min": None, "max": None, "res": []}
+            m["count"] += int(h.get("count") or 0)
+            m["sum"] += float(h.get("sum") or 0.0)
+            for key, pick in (("min", min), ("max", max)):
+                v = h.get(key)
+                if v is not None:
+                    m[key] = v if m[key] is None else pick(m[key], v)
+            m["res"].extend(float(x) for x in (h.get("res") or ()))
+    rng = random.Random(0)
+    for m in hists.values():
+        if len(m["res"]) > reservoir:
+            m["res"] = rng.sample(m["res"], reservoir)
+    return {"counters": counters, "gauges": gauges, "hists": hists}
+
+
+def hist_quantile(h: dict | None, q: float) -> float | None:
+    """Quantile of a snapshot-form histogram dict (or None)."""
+    if not h:
+        return None
+    return _quantile_sorted(sorted(h.get("res") or ()), q)
+
+
+def hist_stats(h: dict | None) -> dict | None:
+    """Reduce a snapshot-form histogram to derived stats (drops the
+    raw reservoir — this is what lands in run_report.json)."""
+    if not h or not h.get("count"):
+        return None
+    res = sorted(h.get("res") or ())
+    return {
+        "count": h["count"],
+        "sum": h["sum"],
+        "mean": h["sum"] / h["count"],
+        "min": h.get("min"),
+        "max": h.get("max"),
+        "p50": _quantile_sorted(res, 0.50),
+        "p90": _quantile_sorted(res, 0.90),
+        "p99": _quantile_sorted(res, 0.99),
+    }

@@ -1,0 +1,148 @@
+"""End-of-run telemetry report.
+
+The scheduler (apps/_runner.py) merges its own registry with the
+per-node snapshots piggybacked on heartbeats, folds in exact per-server
+push/pull stats from `PSClient.stats()`, builds this report, prints a
+human summary plus one machine line
+
+    [run-report] {...json...}
+
+and, when WH_OBS_DIR is set, writes `run_report.json` there atomically.
+The launcher also watches the scheduler's stdout for the machine line
+and writes the file if the scheduler's write didn't land on the
+launcher's filesystem (multi-host). Single-process solver runs build
+the report directly from the global registry.
+
+Histograms are reduced to derived stats (count/sum/mean/min/max/
+p50/p90/p99) so the report stays small enough for a stdout line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from wormhole_tpu.obs import metrics
+
+REPORT_PREFIX = "[run-report] "
+REPORT_NAME = "run_report.json"
+
+
+def enabled() -> bool:
+    return bool(os.environ.get("WH_OBS_DIR", "").strip())
+
+
+def build(aggregate: dict, nodes=(), run_id=None,
+          ps_stats=None, extra=None) -> dict:
+    """Shape a merged metrics snapshot into the run report.
+
+    aggregate: a snapshot dict (metrics.merge_snapshots output);
+    ps_stats: {rank: stats-dict} from PSClient.stats() — its
+    num_push/num_pull are authoritative (surviving-incarnation truth
+    straight from the servers), counters are the fallback.
+    """
+    c = dict(aggregate.get("counters") or {})
+    g = dict(aggregate.get("gauges") or {})
+    hists = aggregate.get("hists") or {}
+    num_push = num_pull = None
+    if ps_stats:
+        num_push = sum(int(s.get("num_push", 0)) for s in ps_stats.values())
+        num_pull = sum(int(s.get("num_pull", 0)) for s in ps_stats.values())
+    rpc = hists.get("ps.client.rpc_s")
+    summary = {
+        "num_push": num_push if num_push is not None
+        else c.get("ps.server.num_push", 0),
+        "num_pull": num_pull if num_pull is not None
+        else c.get("ps.server.num_pull", 0),
+        "bytes_pushed": c.get("ps.client.bytes_push", 0),
+        "bytes_pulled": c.get("ps.client.bytes_pull", 0),
+        "net_bytes_sent": c.get("net.bytes_sent", 0),
+        "net_bytes_recv": c.get("net.bytes_recv", 0),
+        "rpc_p50_ms": _ms(metrics.hist_quantile(rpc, 0.50)),
+        "rpc_p99_ms": _ms(metrics.hist_quantile(rpc, 0.99)),
+        "connect_retries": c.get("net.connect_retries", 0),
+        "ps_retries": c.get("ps.client.retries", 0),
+        "journal_replays": c.get("ps.client.replays", 0),
+        "replay_dedup_hits": c.get("ps.client.replay_dedup", 0),
+        "push_dedup_hits": c.get("ps.server.dedup_hits", 0),
+        "server_recoveries": c.get("sched.server_recoveries", 0),
+        "server_restores": c.get("ps.server.restores", 0),
+        "liveness_evictions": c.get("sched.liveness_evictions", 0),
+    }
+    report = {
+        "run_id": run_id or os.environ.get("WH_RUN_ID"),
+        "generated_unix": time.time(),
+        "nodes": sorted(nodes),
+        "summary": summary,
+        "counters": c,
+        "gauges": g,
+        "hists": {k: metrics.hist_stats(h) for k, h in sorted(hists.items())
+                  if h and h.get("count")},
+    }
+    if ps_stats:
+        report["ps_servers"] = {str(k): v for k, v in sorted(ps_stats.items())}
+    if extra:
+        report.update(extra)
+    return report
+
+
+def build_local(run_id=None, extra=None) -> dict:
+    """Report for a single-process run, straight off the global
+    registry (no scheduler to aggregate)."""
+    from wormhole_tpu.obs import trace
+
+    return build(metrics.REGISTRY.snapshot(), nodes=[trace.node_id()],
+                 run_id=run_id, extra=extra)
+
+
+def write(report: dict, out_dir=None) -> str | None:
+    """Atomically write run_report.json into `out_dir` (default
+    WH_OBS_DIR). Returns the path, or None when disabled."""
+    out_dir = out_dir or os.environ.get("WH_OBS_DIR", "").strip()
+    if not out_dir:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, REPORT_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True, default=str)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def machine_line(report: dict) -> str:
+    """The one-line form the launcher scrapes from scheduler stdout."""
+    return REPORT_PREFIX + json.dumps(report, separators=(",", ":"),
+                                      sort_keys=True, default=str)
+
+
+def format_lines(report: dict) -> list[str]:
+    """Human summary printed at end of run."""
+    s = report["summary"]
+    lines = [
+        "run report"
+        + (f" ({report['run_id']})" if report.get("run_id") else "")
+        + f": {len(report.get('nodes') or [])} nodes",
+        f"  pushes={s['num_push']} pulls={s['num_pull']} "
+        f"bytes_pushed={s['bytes_pushed']} bytes_pulled={s['bytes_pulled']}",
+        f"  net: sent={s['net_bytes_sent']}B recv={s['net_bytes_recv']}B "
+        f"connect_retries={s['connect_retries']}",
+    ]
+    if s["rpc_p50_ms"] is not None:
+        lines.append(f"  rpc latency: p50={s['rpc_p50_ms']:.3f}ms "
+                     f"p99={s['rpc_p99_ms']:.3f}ms")
+    lines.append(
+        f"  recovery: retries={s['ps_retries']} "
+        f"replays={s['journal_replays']} "
+        f"(dedup {s['replay_dedup_hits']}) "
+        f"push_dedup={s['push_dedup_hits']} "
+        f"server_recoveries={s['server_recoveries']} "
+        f"restores={s['server_restores']} "
+        f"evictions={s['liveness_evictions']}")
+    return lines
+
+
+def _ms(v):
+    return None if v is None else v * 1000.0

@@ -1,0 +1,171 @@
+"""Distributed trace spans/events as per-node append-only JSONL.
+
+Opt-in via WH_OBS_DIR (the same contract as runtime/faults.py: a
+module-level handle that is None when disabled, so every hook site is
+one None check and an unfaulted/untraced process pays nothing).
+
+When enabled, each process incarnation appends to its own file
+
+    WH_OBS_DIR/trace-<node>-<pid>.jsonl
+
+so respawned servers never collide with their dead predecessor's file
+and a crash mid-write loses at most one line (append-only,
+line-buffered). The first line is a clock anchor
+
+    {"ph": "M", "run": ..., "node": ..., "pid": ...,
+     "wall": time.time(), "mono": time.monotonic()}
+
+mapping this process's monotonic clock to wall time; every span/event
+carries monotonic timestamps (immune to NTP steps) and the viewer
+(tools/trace_viewer.py) uses the anchor to place nodes on a shared
+wall-clock axis. Lines:
+
+    {"ph": "X", "name": ..., "cat": ..., "ts": mono_s, "dur": s,
+     "tid": small-int, "args": {...}}          # a completed span
+    {"ph": "i", "name": ..., "cat": ..., "ts": mono_s, "tid": ...,
+     "args": {...}}                            # an instant event
+
+Identity: run id from WH_RUN_ID (the launcher exports one per launch),
+node id "<role>-<rank>" from WH_ROLE/WH_RANK, or "local-<pid>" for
+single-process runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Tracer:
+    def __init__(self, out_dir: str, run_id: str, node: str):
+        self.out_dir = out_dir
+        self.run_id = run_id
+        self.node = node
+        self.pid = os.getpid()
+        self.path = os.path.join(out_dir, f"trace-{node}-{self.pid}.jsonl")
+        os.makedirs(out_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._tids: dict[int, int] = {}
+        self._fh = open(self.path, "a", buffering=1)
+        self._write({"ph": "M", "run": run_id, "node": node,
+                     "pid": self.pid, "wall": time.time(),
+                     "mono": time.monotonic()})
+
+    def _write(self, obj: dict) -> None:
+        line = json.dumps(obj, separators=(",", ":"), default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids)
+            return tid
+
+    def emit_span(self, name: str, cat: str, t0: float, dur: float,
+                  args: Optional[dict] = None) -> None:
+        rec = {"ph": "X", "name": name, "cat": cat,
+               "ts": round(t0, 6), "dur": round(dur, 6),
+               "tid": self._tid()}
+        if args:
+            rec["args"] = args
+        self._write(rec)
+
+    def event(self, name: str, cat: str = "event", **args) -> None:
+        rec = {"ph": "i", "name": name, "cat": cat,
+               "ts": round(time.monotonic(), 6), "tid": self._tid()}
+        if args:
+            rec["args"] = args
+        self._write(rec)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
+
+ACTIVE: Optional[Tracer] = None
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "cat", "args", "t0")
+
+    def __init__(self, tracer: Tracer, name: str, cat: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        dur = time.monotonic() - self.t0
+        if etype is not None:
+            self.args = dict(self.args or {}, error=etype.__name__)
+        self.tracer.emit_span(self.name, self.cat, self.t0, dur, self.args)
+        return False
+
+
+def span(name: str, cat: str = "span", **args):
+    """Context manager timing a block into the trace. When tracing is
+    off this returns a shared no-op object — no allocation, no clock
+    read — so it is safe on hot paths."""
+    t = ACTIVE
+    if t is None:
+        return _NULL_SPAN
+    return _Span(t, name, cat, args)
+
+
+def event(name: str, cat: str = "event", **args) -> None:
+    """Emit an instant event (recovery, restore, eviction...)."""
+    t = ACTIVE
+    if t is not None:
+        t.event(name, cat, **args)
+
+
+def node_id() -> str:
+    role = os.environ.get("WH_ROLE")
+    if role:
+        return f"{role}-{os.environ.get('WH_RANK', '0')}"
+    return f"local-{os.getpid()}"
+
+
+def init_from_env() -> Optional[Tracer]:
+    """(Re)read WH_OBS_DIR; called once at import. Tests call it again
+    after mutating the env."""
+    global ACTIVE
+    if ACTIVE is not None:
+        ACTIVE.close()
+        ACTIVE = None
+    out_dir = os.environ.get("WH_OBS_DIR", "").strip()
+    if not out_dir:
+        return None
+    run_id = os.environ.get("WH_RUN_ID") or f"run-{int(time.time())}"
+    ACTIVE = Tracer(out_dir, run_id, node_id())
+    return ACTIVE
+
+
+init_from_env()

@@ -25,6 +25,7 @@ shard with the reference's part naming (see utils/checkpoint.py).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Optional
 
 import jax
@@ -32,7 +33,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.parallel.mesh import table_sharding
+
+_GATHER_S = _obs.REGISTRY.histogram("kv.gather_s")
+_SCATTER_S = _obs.REGISTRY.histogram("kv.scatter_s")
+_GATHER_ROWS = _obs.REGISTRY.counter("kv.gather_rows")
+_SCATTER_ROWS = _obs.REGISTRY.counter("kv.scatter_rows")
 
 
 @dataclasses.dataclass
@@ -117,9 +124,13 @@ class KVStore:
         if idx.size == 0:
             tail = self.state[name].shape[1:]
             return np.empty((0, *tail), np.float32)
+        t0 = time.perf_counter()
         pad, n = self._pad_pow2(np.asarray(idx), 0)
         out = self._gather_fn(self.state[name], jnp.asarray(pad))
-        return np.asarray(out[:n], dtype=np.float32)
+        out = np.asarray(out[:n], dtype=np.float32)
+        _GATHER_S.observe(time.perf_counter() - t0)
+        _GATHER_ROWS.inc(n)
+        return out
 
     def scatter_rows(self, name: str, idx: np.ndarray,
                      vals: np.ndarray) -> None:
@@ -128,6 +139,7 @@ class KVStore:
         mode='drop', so they never land."""
         if idx.size == 0:
             return
+        t0 = time.perf_counter()
         fn = self._scatter_fns.get(name)
         if fn is None:
             sh = self.sharding(name)
@@ -142,6 +154,8 @@ class KVStore:
         v[:n] = vals
         self.state[name] = fn(self.state[name], jnp.asarray(pad),
                               jnp.asarray(v))
+        _SCATTER_S.observe(time.perf_counter() - t0)
+        _SCATTER_ROWS.inc(n)
 
     def zero_init_names(self) -> set[str]:
         """Tables created as zeros (spec.init is None) — the PS plane

@@ -19,6 +19,9 @@ marks a compressed buffer ("nbytes" is then the compressed size,
 
 Fault injection (runtime/faults.py) hooks frame send/recv; the guards
 are module-level None checks so an unfaulted process pays nothing.
+Wire accounting (frames/bytes in+out, encode/decode latency, connect
+retries) lands in the process-wide metrics registry (wormhole_tpu/obs)
+via handles cached at import.
 """
 
 from __future__ import annotations
@@ -32,9 +35,19 @@ from typing import Optional
 
 import numpy as np
 
+from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.runtime import faults
 
 _COMPRESS_MIN = 512  # don't bother compressing tiny buffers
+
+# handles cached at import: per-frame cost is an inc, never a dict walk
+_FRAMES_SENT = _obs.REGISTRY.counter("net.frames_sent")
+_FRAMES_RECV = _obs.REGISTRY.counter("net.frames_recv")
+_BYTES_SENT = _obs.REGISTRY.counter("net.bytes_sent")
+_BYTES_RECV = _obs.REGISTRY.counter("net.bytes_recv")
+_CONNECT_RETRIES = _obs.REGISTRY.counter("net.connect_retries")
+_ENCODE_S = _obs.REGISTRY.histogram("net.encode_s")
+_DECODE_S = _obs.REGISTRY.histogram("net.decode_s")
 
 
 def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
@@ -47,6 +60,7 @@ def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
         try:
             return socket.create_connection(addr, timeout=timeout)
         except OSError:
+            _CONNECT_RETRIES.inc()
             if time.monotonic() >= deadline:
                 raise
             time.sleep(backoff)
@@ -128,6 +142,7 @@ def send_frame(sock_file, header: dict,
     (the wire-accounting unit PSClient reports)."""
     if faults.ACTIVE is not None:
         faults.ACTIVE.frame(header.get("op"))
+    t0 = time.perf_counter()
     metas, bufs = [], []
     for name, a in (arrays or {}).items():
         m, b = _encode(a, fixed_bytes, compress)
@@ -136,6 +151,7 @@ def send_frame(sock_file, header: dict,
         bufs.append(b)
     header = dict(header, arrays=metas)
     h = json.dumps(header).encode()
+    _ENCODE_S.observe(time.perf_counter() - t0)
     sock_file.write(struct.pack(">I", len(h)))
     sock_file.write(h)
     total = 4 + len(h)
@@ -143,6 +159,8 @@ def send_frame(sock_file, header: dict,
         sock_file.write(b)
         total += len(b)
     sock_file.flush()
+    _FRAMES_SENT.inc()
+    _BYTES_SENT.inc(total)
     return total
 
 
@@ -156,7 +174,11 @@ def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
     h = _read_exact(sock_file, hlen)
     if h is None:
         return None
+    # decode latency excludes the socket reads (network wait is not
+    # deserialization cost): time json.loads + _decode only
+    t0 = time.perf_counter()
     header = json.loads(h)
+    decode_s = time.perf_counter() - t0
     total = 4 + hlen
     arrays = {}
     for m in header.get("arrays", []):
@@ -164,5 +186,10 @@ def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
         if buf is None:
             return None
         total += m["nbytes"]
+        t0 = time.perf_counter()
         arrays[m["name"]] = _decode(m, buf)
+        decode_s += time.perf_counter() - t0
+    _DECODE_S.observe(decode_s)
+    _FRAMES_RECV.inc()
+    _BYTES_RECV.inc(total)
     return header, arrays, total

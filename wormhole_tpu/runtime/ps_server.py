@@ -54,12 +54,33 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime.net import (  # noqa: F401  (re-exported: the wire
     _COMPRESS_MIN, _decode, _encode, _read_exact, connect_with_retry,
     recv_frame, send_frame)  # format moved to net.py so fault injection can
 # hook frame send/recv for every net user; tests and tools keep importing
 # the names from here.
+
+# registry handles cached at import (see wormhole_tpu/obs/metrics.py)
+_NUM_PUSH = _obs.REGISTRY.counter("ps.server.num_push")
+_NUM_PULL = _obs.REGISTRY.counter("ps.server.num_pull")
+_DEDUP_HITS = _obs.REGISTRY.counter("ps.server.dedup_hits")
+_SNAPSHOTS = _obs.REGISTRY.counter("ps.server.snapshots")
+_SNAPSHOT_S = _obs.REGISTRY.histogram("ps.server.snapshot_s")
+_RESTORES = _obs.REGISTRY.counter("ps.server.restores")
+_RESTORE_EPOCH = _obs.REGISTRY.gauge("ps.server.restore_epoch")
+_RPC_S = _obs.REGISTRY.histogram("ps.client.rpc_s")
+_BYTES_PUSH = _obs.REGISTRY.counter("ps.client.bytes_push")
+_BYTES_PULL = _obs.REGISTRY.counter("ps.client.bytes_pull")
+_RETRIES = _obs.REGISTRY.counter("ps.client.retries")
+_REPLAYS = _obs.REGISTRY.counter("ps.client.replays")
+_REPLAY_DEDUP = _obs.REGISTRY.counter("ps.client.replay_dedup")
+_ROLLBACKS = _obs.REGISTRY.counter("ps.client.rollback_repulls")
+_SYNCS = _obs.REGISTRY.counter("ps.client.syncs")
+_SYNC_PUSH_S = _obs.REGISTRY.histogram("ps.client.sync_push_s")
+_SYNC_PULL_S = _obs.REGISTRY.histogram("ps.client.sync_pull_s")
 
 # init_spec claim TTL: how long a server waits for a claimant's
 # init_arrays before handing the claim to the next poller. Clients wait
@@ -249,6 +270,17 @@ class ServerNode:
     # -- ops ----------------------------------------------------------------
     def _dispatch(self, header: dict, arrays: dict) -> tuple[dict, dict]:
         op = header.get("op")
+        t0 = time.perf_counter()
+        try:
+            return self._dispatch_op(op, header, arrays)
+        finally:
+            # per-op service latency (what the server spent, not what the
+            # client waited — that's ps.client.rpc_s)
+            _obs.REGISTRY.histogram(f"ps.server.op.{op}_s").observe(
+                time.perf_counter() - t0)
+
+    def _dispatch_op(self, op, header: dict,
+                     arrays: dict) -> tuple[dict, dict]:
         if faults.ACTIVE is not None:
             faults.ACTIVE.server_op(op)
         if op == "hello":
@@ -374,11 +406,13 @@ class ServerNode:
             if since is None:
                 with self._lock:
                     self.num_pull += 1
+                    _NUM_PULL.inc()
                     self._recompute_derived()
                     out = {k: v.copy() for k, v in self.tables.items()}
                     return {"ok": True, "clock": self.clock}, out
             with self._lock:
                 self.num_pull += 1
+                _NUM_PULL.inc()
                 out = {}
                 if since >= self.clock:
                     # nothing pushed since the caller last looked: skip
@@ -415,10 +449,12 @@ class ServerNode:
                 sender, seq = header.get("sender"), header.get("seq")
                 if sender is not None and seq is not None:
                     if seq <= self._last_seq.get(sender, 0):
+                        _DEDUP_HITS.inc()
                         return ({"ok": True, "clock": self.clock,
                                  "dup": True}, {})
                     self._last_seq[sender] = int(seq)
                 self.num_push += 1
+                _NUM_PUSH.inc()
                 self.clock += 1
                 # uint32 stamp wrap would silently freeze rows as
                 # never-dirty; unreachable in practice, but fail loudly
@@ -703,6 +739,19 @@ class ServerNode:
         fence, and the table metadata a respawned server needs to resume
         MID-training without a worker re-init. Skips when no push landed
         since the last snapshot or tables aren't fully created yet."""
+        t0 = time.perf_counter()
+        path = self._snapshot_impl()
+        if path is not None:
+            dur = time.perf_counter() - t0
+            _SNAPSHOT_S.observe(dur)
+            _SNAPSHOTS.inc()
+            if _trace.ACTIVE is not None:
+                _trace.ACTIVE.emit_span(
+                    "ps.snapshot", "ps", time.monotonic() - dur, dur,
+                    {"rank": self.rank, "clock": self._snap_clock})
+        return path
+
+    def _snapshot_impl(self) -> Optional[str]:
         from wormhole_tpu.utils.checkpoint import atomic_savez, part_name
 
         with self._lock:
@@ -775,6 +824,10 @@ class ServerNode:
                 self._reset_pushlog(g)
             self._loaded = True
             self._stamped_all = set()
+        _RESTORES.inc()
+        _RESTORE_EPOCH.set(self.epoch)
+        _trace.event("ps.restore", cat="recovery", rank=self.rank,
+                     clock=self.clock, epoch=self.epoch)
         print(f"[ps server {self.rank}] restored snapshot {path} "
               f"(clock {self.clock}, epoch {self.epoch})", flush=True)
         return True
@@ -859,6 +912,9 @@ class PSClient:
             # rolled back to the snapshot clock. Flag it so the next
             # versioned pull re-adopts the full restored state.
             self._rolled_back[r] = True
+            _ROLLBACKS.inc()
+            _trace.event("ps.rollback", cat="recovery", server=r,
+                         epoch_from=last, epoch_to=ep)
             print(f"[ps-retry] server {r} epoch {last} -> {ep}: "
                   "rolled back to its last snapshot; scheduling a "
                   "full re-pull", flush=True)
@@ -875,6 +931,8 @@ class PSClient:
             # reuses the stamp — that's what the dedup keys on)
             self._seq[r] += 1
             header = dict(header, sender=self.sender, seq=self._seq[r])
+        t_rpc = time.monotonic()
+        recovered = False
         while True:
             try:
                 h, arrs, sent, received = self._attempt(
@@ -896,17 +954,31 @@ class PSClient:
                         "died; the job must be restarted (resume from "
                         "the last _iter-K checkpoint)") from e
                 self._recover(r, op_name, e)
+                recovered = True
+        dur = time.monotonic() - t_rpc
+        _RPC_S.observe(dur)
+        if _trace.ACTIVE is not None:
+            _trace.ACTIVE.emit_span(f"rpc.{op_name}", "rpc", t_rpc, dur,
+                                    {"server": r})
+        if recovered and op_name == "push" and self.sender is not None:
+            # the in-flight push re-sent after a reconnect is itself a
+            # replay: count it, and whether the fence absorbed it
+            _REPLAYS.inc()
+            if h.get("dup"):
+                _REPLAY_DEDUP.inc()
         if "error" in h:
             raise RuntimeError(f"ps server error: {h['error']}")
         self._note_epoch(r, h)
         op = header.get("op")
         if op == "push":
             self.bytes_push += sent + received
+            _BYTES_PUSH.inc(sent + received)
             if self.retry_deadline > 0 and self.sender is not None:
                 self._journal[r].append(
                     (header["seq"], header, arrays, fixed_bytes, compress))
         elif op == "pull":
             self.bytes_pull += sent + received
+            _BYTES_PULL.inc(sent + received)
         elif op in ("init", "init_spec", "init_arrays"):
             self.bytes_init += sent + received
         return h, arrs
@@ -949,6 +1021,9 @@ class PSClient:
                     False)
                 self._note_epoch(r, h)
                 self.num_retries += 1
+                _RETRIES.inc()
+                _trace.event("ps.reconnect", cat="recovery", server=r,
+                             uri=self.uris[r], epoch=self._epochs[r])
                 applied = int(h.get("last_seq", 0))
                 replay = [e for e in self._journal[r] if e[0] > applied]
                 # the RPC being retried is re-sent by _rpc after we
@@ -972,6 +1047,9 @@ class PSClient:
                     if "error" in rh:
                         raise RuntimeError(
                             f"ps server error on replay: {rh['error']}")
+                    _REPLAYS.inc()
+                    if rh.get("dup"):
+                        _REPLAY_DEDUP.inc()
                 if replay:
                     print(f"[ps-retry] server {r}: replayed "
                           f"{len(replay)} journaled pushes "
@@ -1309,18 +1387,24 @@ class SyncedStore:
 
     def sync(self) -> None:
         t0 = time.perf_counter()
-        got = self._touched_groups()
-        if got is None:
-            got = self._scan_groups()
-        groups, deltas = got
-        self.client.push_sparse(groups, deltas,
-                                fixed_bytes=self.fixed_bytes,
-                                compress=self.compress)
+        with _trace.span("ps.sync.push", cat="ps"):
+            got = self._touched_groups()
+            if got is None:
+                got = self._scan_groups()
+            groups, deltas = got
+            self.client.push_sparse(groups, deltas,
+                                    fixed_bytes=self.fixed_bytes,
+                                    compress=self.compress)
         t1 = time.perf_counter()
-        self._apply_pull()
+        with _trace.span("ps.sync.pull", cat="ps"):
+            self._apply_pull()
+        t2 = time.perf_counter()
+        _SYNC_PUSH_S.observe(t1 - t0)
+        _SYNC_PULL_S.observe(t2 - t1)
+        _SYNCS.inc()
         if self.perf is not None:
             self.perf.add("ps_push", t1 - t0)
-            self.perf.add("ps_pull", time.perf_counter() - t1)
+            self.perf.add("ps_pull", t2 - t1)
         self._steps = 0
         self.num_syncs += 1
 

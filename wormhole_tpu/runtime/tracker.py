@@ -33,10 +33,16 @@ import time
 from enum import Enum
 from typing import Optional
 
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime.net import connect_with_retry
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
+
+_EVICTIONS = _obs.REGISTRY.counter("sched.liveness_evictions")
+_SRV_RECOVERIES = _obs.REGISTRY.counter("sched.server_recoveries")
+_BARRIER_WAIT_S = _obs.REGISTRY.histogram("sched.barrier_wait_s")
 
 
 class Role(str, Enum):
@@ -115,6 +121,11 @@ class Scheduler:
         self._shutdown = False                   # job end; workers exit
         self._seen_workers: set[str] = set()     # workers ever registered
         self._blobs: dict[str, str] = {}         # rendezvous KV payloads
+        # latest metrics snapshot each node piggybacked on a heartbeat
+        # (keyed by node name, so a respawned server's snapshot replaces
+        # its dead incarnation's — surviving-incarnation semantics, same
+        # as PSClient.stats())
+        self._node_metrics: dict[str, dict] = {}
         self.num_server_recoveries = 0           # servers that re-registered
         self._done = False
         self._srv = _Server((host, port), _Handler)
@@ -266,13 +277,29 @@ class Scheduler:
     # -- RPC ops ------------------------------------------------------------
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
+        t0 = time.perf_counter()
+        try:
+            return self._dispatch_op(op, req)
+        finally:
+            _obs.REGISTRY.histogram(f"sched.op.{op}_s").observe(
+                time.perf_counter() - t0)
+
+    def _dispatch_op(self, op, req: dict) -> dict:
         if faults.ACTIVE is not None:
             faults.ACTIVE.sched_op(op)
         node = req.get("node", "?")
+        snap = req.get("metrics")
         with self._lock:
             self._nodes[node] = time.monotonic()
             if node.startswith("worker"):
                 self._seen_workers.add(node)
+            if isinstance(snap, dict):
+                # heartbeat-piggybacked metrics snapshot (any op may
+                # carry one; LivenessPinger/heartbeat loops do, and a
+                # final one rides the worker's `bye`)
+                self._node_metrics[node] = snap
+        if op == "metrics":
+            return {"ok": True, **self.aggregate_metrics()}
         if op == "register":
             return {"ok": True, "epoch": self._epoch}
         if op == "register_server":
@@ -290,6 +317,9 @@ class Scheduler:
                     self.num_server_recoveries += 1
                     self.progress.merge({"server_recoveries": 1.0})
             if recovered:
+                _SRV_RECOVERIES.inc()
+                _trace.event("sched.server_recovered", cat="recovery",
+                             rank=rank, uri=req["uri"], prev=prev)
                 print(f"[recovery] ps server-{rank} re-registered at "
                       f"{req['uri']} (was {prev})", flush=True)
             return {"ok": True}
@@ -397,6 +427,18 @@ class Scheduler:
                 return {"released": True, "gen": gen}
             return {"released": False, "gen": gen}
 
+    # -- telemetry ----------------------------------------------------------
+    def aggregate_metrics(self) -> dict:
+        """Cluster-wide metrics view: this process's registry merged
+        with the latest snapshot each node piggybacked on a heartbeat.
+        The payload of the `metrics` dispatch verb and the raw material
+        of the end-of-run report (obs/report.py)."""
+        with self._lock:
+            snaps = dict(self._node_metrics)
+        merged = _obs.merge_snapshots(
+            [_obs.REGISTRY.snapshot(), *snaps.values()])
+        return {"nodes": sorted(snaps), "aggregate": merged}
+
     # -- liveness -----------------------------------------------------------
     def live_workers(self) -> list[str]:
         """Workers currently in the liveness table."""
@@ -430,7 +472,10 @@ class Scheduler:
                         if now - seen > self.node_timeout]
                 for n in dead:
                     del self._nodes[n]
+            if dead:
+                _EVICTIONS.inc(len(dead))
             for n in dead:
+                _trace.event("sched.liveness_evict", cat="recovery", node=n)
                 if n.startswith("server"):
                     # servers carry no pool parts; their loss is its own
                     # first-class event (the launcher's respawn loop — if
@@ -543,16 +588,23 @@ class SchedulerClient:
         timeout, raises TimeoutError instead of waiting forever for a
         peer that died before arriving."""
         deadline = (time.monotonic() + timeout) if timeout else None
-        r = self.call(op="barrier", name=name, world=world)
-        if r["released"]:
-            return
-        gen = r["gen"]
-        while True:
-            time.sleep(poll)
-            if self.call(op="barrier_wait", name=name, gen=gen)["released"]:
-                return
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"barrier {name!r} never released")
+        t_enter = time.monotonic()
+        with _trace.span(f"barrier.{name}", cat="sched", world=world):
+            try:
+                r = self.call(op="barrier", name=name, world=world)
+                if r["released"]:
+                    return
+                gen = r["gen"]
+                while True:
+                    time.sleep(poll)
+                    if self.call(op="barrier_wait", name=name,
+                                 gen=gen)["released"]:
+                        return
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"barrier {name!r} never released")
+            finally:
+                _BARRIER_WAIT_S.observe(time.monotonic() - t_enter)
 
 
 class LivenessPinger:
@@ -568,7 +620,10 @@ class LivenessPinger:
         def loop():
             while not self._stop.wait(interval):
                 try:
-                    client.call(op="epoch")
+                    # piggyback this process's metrics snapshot on the
+                    # liveness ping — the scheduler-aggregation channel
+                    client.call(op="epoch",
+                                metrics=_obs.REGISTRY.snapshot())
                 except Exception:
                     pass
 

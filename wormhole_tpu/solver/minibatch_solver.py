@@ -25,6 +25,8 @@ from typing import Callable, Optional
 import numpy as np
 
 from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.obs import report as _report
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import WorkloadPool, WorkType
 from wormhole_tpu.utils import checkpoint as ckpt
@@ -71,6 +73,12 @@ class MinibatchSolver:
         result = {}
         with maybe_trace("minibatch_solver"):
             result = self._run_passes(cfg)
+        if _report.enabled() and not os.environ.get("WH_ROLE"):
+            # single-process run: no scheduler to aggregate, so this
+            # process's registry IS the whole job — write the report
+            # directly (distributed runs get it from apps/_runner.py)
+            path = _report.write(_report.build_local())
+            self._log(f"[obs] run report written: {path}")
         return result
 
     def _run_passes(self, cfg) -> dict:
@@ -186,22 +194,26 @@ class MinibatchSolver:
             self._log(f"{mode} pass {data_pass}: {data}")
             self._log(Progress.header())
         try:
-            while done_loaders < len(threads):
-                t_w = time.perf_counter()
-                item = q.get()
-                self.perf.add("wait", time.perf_counter() - t_w)
-                if item is _END:
-                    done_loaders += 1
-                    continue
-                t_s = time.perf_counter()
-                prog.merge(step(item))
-                dt = time.perf_counter() - t_s
-                self.perf.add(f"{mode}_step", dt)
-                t_step += dt
-                n_steps += 1
-                if self.verbose and time.time() - last_print >= cfg.print_sec:
-                    self._log(prog.row(self.t0))
-                    last_print = time.time()
+            with _trace.span(f"{mode}_pass", cat="solver",
+                             data_pass=data_pass):
+                while done_loaders < len(threads):
+                    t_w = time.perf_counter()
+                    item = q.get()
+                    self.perf.add("wait", time.perf_counter() - t_w)
+                    if item is _END:
+                        done_loaders += 1
+                        continue
+                    t_s = time.perf_counter()
+                    with _trace.span(f"{mode}_step", cat="solver"):
+                        prog.merge(step(item))
+                    dt = time.perf_counter() - t_s
+                    self.perf.add(f"{mode}_step", dt)
+                    t_step += dt
+                    n_steps += 1
+                    if self.verbose \
+                            and time.time() - last_print >= cfg.print_sec:
+                        self._log(prog.row(self.t0))
+                        last_print = time.time()
         finally:
             stop.set()
             for t in threads:

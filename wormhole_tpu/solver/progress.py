@@ -50,6 +50,19 @@ class Progress:
             self._last = dict(self.tot)
             return inc
 
+    def take_row_snapshot(self) -> tuple[dict, dict]:
+        """Consistent (increment, totals) pair under ONE lock hold.
+        row() needs both; taking the increment and then reading
+        self.tot unlocked let RPC handler threads merge in between, so
+        a row could show totals that include examples its own increment
+        did not — inc sums across rows would never reconcile with the
+        final totals."""
+        with self._lock:
+            inc = {k: v - self._last.get(k, 0.0)
+                   for k, v in self.tot.items()}
+            self._last = dict(self.tot)
+            return inc, dict(self.tot)
+
     @staticmethod
     def header() -> str:
         # column parity with the reference training log (linear
@@ -62,13 +75,13 @@ class Progress:
                 f"{'auc':>9} {'copc':>7}")
 
     def row(self, t0: float) -> str:
-        inc = self.take_increment()
+        inc, tot = self.take_row_snapshot()
         n = inc.get("nex", 0.0)
         def m(k):
             return inc.get(k, 0.0) / n if n else 0.0
         pclk = inc.get("pclk", 0.0)
         copc = inc.get("clk", 0.0) / pclk if pclk else 0.0
-        return (f"{time.time() - t0:8.1f} {self.tot.get('nex', 0):12.0f} "
-                f"{n:10.0f} {self.tot.get('new_w', 0):10.0f} "
+        return (f"{time.time() - t0:8.1f} {tot.get('nex', 0):12.0f} "
+                f"{n:10.0f} {tot.get('new_w', 0):10.0f} "
                 f"{m('logloss'):9.5f} {m('acc'):9.5f} "
                 f"{m('auc'):9.5f} {copc:7.4f}")

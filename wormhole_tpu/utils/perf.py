@@ -8,6 +8,13 @@ Parity targets (SURVEY §5 tracing/profiling):
   logs mean latencies every N ops (difacto async_sgd.h:108-127);
 - beyond parity: `maybe_trace` hooks the JAX profiler so a run can emit
   an XProf trace by setting WORMHOLE_PROFILE_DIR.
+
+Every Perf.add is mirrored into the process-wide metrics registry
+(wormhole_tpu/obs) as histogram `perf.<op>_s`, so Perf timings ride the
+heartbeat-piggybacked snapshots and land in run_report.json without
+callers changing anything. The local sums/counts (and their API:
+snapshot/mean_ms/total/count/row) stay as the cheap in-object view the
+solver and tests already use.
 """
 
 from __future__ import annotations
@@ -17,6 +24,8 @@ import os
 import threading
 import time
 from typing import Callable, Optional
+
+from wormhole_tpu.obs import metrics as _obs
 
 
 class Perf:
@@ -31,12 +40,17 @@ class Perf:
                  log_every: int = 0):
         self._sum: dict[str, float] = {}
         self._cnt: dict[str, int] = {}
+        self._hists: dict[str, _obs.Histogram] = {}  # registry mirrors
         self._lock = threading.Lock()
         self._log = log
         self._log_every = log_every
         self._since_log = 0
 
     def add(self, op: str, sec: float) -> None:
+        h = self._hists.get(op)
+        if h is None:
+            h = self._hists[op] = _obs.REGISTRY.histogram(f"perf.{op}_s")
+        h.observe(sec)
         with self._lock:
             self._sum[op] = self._sum.get(op, 0.0) + sec
             self._cnt[op] = self._cnt.get(op, 0) + 1
