@@ -157,6 +157,81 @@ def bench_linear(num_buckets, minibatch, steps=BENCH_STEPS):
     return minibatch / sec
 
 
+def bench_linear_epoch2(num_buckets, minibatch, steps=30):
+    """Epoch-2 steady state at the headline shape: the packed-batch
+    cache is warm, so a loader thread replays prepared batches from
+    memory and stages them to the device (stage_batch) while the main
+    thread steps — the full data/pack_cache.py pipeline minus the one
+    cold pack per batch. Returns (examples/sec, loader stall seconds,
+    wall seconds, cache hit rate): the acceptance bar is stall < 15%
+    of wall, i.e. the device — not the host — paces epoch 2+."""
+    import queue as _queue
+    import threading
+
+    from wormhole_tpu.data import pack_cache as pc
+    from wormhole_tpu.data.rowblock import RowBlock
+    from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+    from wormhole_tpu.parallel.mesh import make_mesh
+
+    cfg = LinearConfig(
+        minibatch=minibatch,
+        num_buckets=num_buckets,
+        nnz_per_row=len(FIELD_CARDS),
+        algo="ftrl",
+        lr_eta=0.1,
+        lambda_l1=1.0,
+        kernel_dtype="bf16",
+    )
+    lrn = LinearLearner(cfg, make_mesh(num_data=1, num_model=1))
+    rng = np.random.default_rng(0)
+    nnz_row = len(FIELD_CARDS)
+    cache = pc.PackCache(mem_bytes=8 << 30)
+    nbatch = 8
+    blks = []
+    for _ in range(nbatch):
+        seg, idx, val, label, mask = synth_criteo_batch(
+            rng, minibatch, num_buckets)
+        offset = np.arange(minibatch + 1, dtype=np.int64) * nnz_row
+        blks.append(RowBlock(label=label, offset=offset,
+                             index=idx.astype(np.uint64), value=val))
+    # epoch 1 (cold): pack once, fill the cache
+    for i, blk in enumerate(blks):
+        cache.put(pc.fingerprint("bench", i), lrn.prepare_batch(blk))
+
+    def run_epoch(n):
+        q: _queue.Queue = _queue.Queue(maxsize=4)
+        END = object()
+
+        def loader():
+            for i in range(n):
+                b = cache.get(pc.fingerprint("bench", i % nbatch))
+                if b is None:  # eviction fallback; not expected here
+                    b = lrn.prepare_batch(blks[i % nbatch])
+                q.put(lrn.stage_batch(b, train=True))
+            q.put(END)
+
+        threading.Thread(target=loader, daemon=True).start()
+        stall = 0.0
+        while True:
+            t0 = time.perf_counter()
+            item = q.get()
+            stall += time.perf_counter() - t0
+            if item is END:
+                break
+            # train_batch fetches the progress scalars, so every step
+            # blocks to completion — the wall below is honest per-step
+            # time including the fetch, like the solver's own loop
+            lrn.train_batch(item)
+        return stall
+
+    run_epoch(WARMUP_STEPS)  # compile + device warmup
+    t0 = time.perf_counter()
+    stall = run_epoch(steps)
+    wall = time.perf_counter() - t0
+    hit = cache.stats()["hit_rate"]
+    return minibatch * steps / wall, stall, wall, hit
+
+
 # --------------------------------------------------------------- difacto
 def bench_difacto(steps=20):
     """FM at the reference's Criteo operating shape: dim=8, two tables
@@ -478,6 +553,14 @@ def main():
         # vs_baseline = fraction of what a dense-table sync would move
         emit("ps_wire_bytes_per_sync_64m_buckets", wire["bytes_per_sync"],
              "bytes", wire["bytes_per_sync"] / dense_bytes)
+    got = _safe("linear_epoch2", bench_linear_epoch2, NUM_BUCKETS, MINIBATCH)
+    if got is not None:
+        eps, stall, wall, hit = got
+        emit("linear_ftrl_criteo_shape_epoch2_cached_examples_per_sec", eps,
+             "examples/sec", eps / BASELINE_EXAMPLES_PER_SEC,
+             pack_cache_hit_rate=round(hit, 4),
+             loader_stall_s=round(stall, 4),
+             loader_stall_frac=round(stall / max(wall, 1e-9), 4))
     # headline LAST: the driver parses the final JSON line. A headline
     # failure must stay LOUD (rc=1) — otherwise the previous line (a
     # different metric in different units) would silently be recorded

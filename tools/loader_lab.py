@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Host-pipeline microbench: per-stage ms/batch for the loader path.
+
+Times each stage of the host side in isolation — parse (text ->
+RowBlock minibatches), pack (prepare_batch: pad + sort/localize),
+cache put/get (data/pack_cache.py round-trip), stage (host -> device
+placement), device step — then the composed cold (epoch 1) vs cached
+(epoch 2) loop through iter_part_cached. This is where "the loader is
+the pacing item" claims get their numbers (PERF.md "Host pipeline").
+
+CPU-safe: defaults JAX_PLATFORMS=cpu when unset, so it runs anywhere
+the tests run. On a TPU host, unset/override to measure real staging.
+
+Usage: python tools/loader_lab.py [--rows N] [--minibatch N]
+       [--num-buckets N] [--nnz N] [--steps N] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _ms_per(fn, items, repeat=1):
+    """Mean milliseconds per item of fn over items (materialized list)."""
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(repeat):
+        for it in items:
+            fn(it)
+            n += 1
+    return (time.perf_counter() - t0) * 1e3 / max(n, 1)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--minibatch", type=int, default=512)
+    ap.add_argument("--num-buckets", type=int, default=1 << 14)
+    ap.add_argument("--nnz", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="device steps to time (default: all batches)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per stage instead of a table")
+    args = ap.parse_args(argv)
+
+    from wormhole_tpu.data import pack_cache as pc
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+    from wormhole_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(args.rows):
+        idx = rng.choice(args.num_buckets, size=args.nnz, replace=False)
+        val = rng.random(args.nnz)
+        y = int(rng.random() < 0.5)
+        lines.append(f"{y} " + " ".join(
+            f"{i}:{v:.4f}" for i, v in zip(idx, val)))
+    results = []
+
+    def stage(name, ms, note=""):
+        row = {"stage": name, "ms_per_batch": round(ms, 3), "note": note}
+        results.append(row)
+        if args.json:
+            print(json.dumps(row), flush=True)
+        else:
+            print(f"{name:<16} {ms:9.3f} ms/batch  {note}", flush=True)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "lab.libsvm")
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+        # 1. parse: text -> RowBlock minibatches (no prefetch thread, so
+        # the number is the parser's own cost, not queue overlap)
+        mk_iter = lambda: MinibatchIter(path, minibatch_size=args.minibatch,
+                                        prefetch=False)
+        blks = list(mk_iter())
+        stage("parse", _ms_per(lambda _: None, mk_iter()),
+              f"{len(blks)} batches of {args.minibatch}")
+
+        cfg = LinearConfig(minibatch=args.minibatch,
+                           num_buckets=args.num_buckets,
+                           nnz_per_row=args.nnz, algo="ftrl", lr_eta=0.1)
+        lrn = LinearLearner(cfg, make_mesh(1, 1))
+
+        # 2. pack: pad to device shape (+ tile sort on the pallas path)
+        stage("pack", _ms_per(lrn.prepare_batch, blks),
+              "prepare_batch (pad + sort/localize)")
+        packed = [lrn.prepare_batch(b) for b in blks]
+
+        # 3/4. cache round-trip, memory tier
+        cache = pc.PackCache(mem_bytes=1 << 30)
+        stage("cache_put", _ms_per(
+            lambda ib: cache.put(pc.fingerprint("lab", ib[0]), ib[1]),
+            list(enumerate(packed))))
+        stage("cache_get", _ms_per(
+            lambda i: cache.get(pc.fingerprint("lab", i)),
+            range(len(packed))))
+
+        # 5. stage: host arrays -> device (the double-buffer's work)
+        stage("stage", _ms_per(lambda b: lrn.stage_batch(b, train=True),
+                               packed))
+        staged = [lrn.stage_batch(b, train=True) for b in packed]
+
+        # 6. device step (blocks on the progress fetch, like the solver)
+        n = args.steps or len(staged)
+        lrn.train_batch(staged[0])  # compile outside the timing
+        stage("step", _ms_per(lrn.train_batch,
+                              [staged[i % len(staged)] for i in range(n)]))
+
+        # composed: cold vs cached epoch through the real replay loop
+        cache2 = pc.PackCache(mem_bytes=1 << 30)
+        key = ("lab-part", pc.file_stamp(path))
+        raw = lambda: MinibatchIter(path, minibatch_size=args.minibatch)
+        t0 = time.perf_counter()
+        cold = list(pc.iter_part_cached(cache2, key, raw,
+                                        lrn.prepare_batch))
+        stage("epoch1_cold",
+              (time.perf_counter() - t0) * 1e3 / max(len(cold), 1),
+              "parse + pack + fill cache")
+        t0 = time.perf_counter()
+        warm = list(pc.iter_part_cached(cache2, key, raw,
+                                        lrn.prepare_batch))
+        stage("epoch2_cached",
+              (time.perf_counter() - t0) * 1e3 / max(len(warm), 1),
+              f"hit_rate={cache2.stats()['hit_rate']:.3f}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,75 @@ def _iter_rowblocks(
             yield blk
 
 
+#: end-of-stream marker on the ThreadedParser queue
+_END = object()
+
+
+class _ParserError:
+    """Queue sentinel carrying a producer-thread exception to the
+    consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class ThreadedParser:
+    """Background prefetch over a RowBlock source (the reference's
+    ThreadedParser, minibatch_iter.h:60).
+
+    The producer thread's terminal state — end-of-stream OR an
+    exception — always travels on the queue itself (`_END` /
+    `_ParserError` sentinels), so a consumer blocked in `get()` is
+    guaranteed a next item even when the parser dies mid-stream; the
+    exception re-raises at the consumer's iteration point instead of
+    the thread dying silently with the iterator parked forever."""
+
+    def __init__(self, src, maxsize: int = 4):
+        self._src = src
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up if the consumer went away, so
+        abandoning the iterator mid-stream can't park the producer (and
+        its open file) forever."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            for blk in self._src:
+                if not self._put(blk):
+                    return
+            self._put(_END)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            self._put(_ParserError(e))
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is _END:
+                    return
+                if isinstance(item, _ParserError):
+                    raise item.exc
+                yield item
+        finally:
+            self.close()
+
+
 class MinibatchIter:
     """Iterate fixed-size minibatches over (part k of n) of one file.
 
@@ -70,47 +139,7 @@ class MinibatchIter:
         if not self.prefetch:
             yield from src
             return
-        q: queue.Queue = queue.Queue(maxsize=4)
-        _END = object()
-        err: list[BaseException] = []
-        stop = threading.Event()
-
-        def produce():
-            try:
-                for blk in src:
-                    # bounded put that gives up if the consumer went away,
-                    # so abandoning the iterator mid-stream can't park this
-                    # thread (and its open file) forever
-                    while not stop.is_set():
-                        try:
-                            q.put(blk, timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:  # surface parser errors to consumer
-                err.append(e)
-            finally:
-                while not stop.is_set():
-                    try:
-                        q.put(_END, timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
-
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _END:
-                    if err:
-                        raise err[0]
-                    return
-                yield item
-        finally:
-            stop.set()
+        yield from ThreadedParser(src)
 
     def _transformed(self) -> Iterator[RowBlock]:
         for blk in self._raw_blocks():

@@ -212,6 +212,10 @@ class DifactoLearner:
         self._fm_steps = None
         self._fm_lock = threading.Lock()
         self._cnt_host = np.zeros(cfg.num_buckets, np.float32)
+        # pack-version counter for the epoch cache: bumped whenever the
+        # count mirror resyncs, since admission (hence the packed vval)
+        # is a function of the mirror's contents
+        self._pack_epoch = 0
         self.ckpt_store.on_load = self.refresh_count_mirror
         self.ckpt_store.on_sparse_pull = self._on_sparse_pull
         # sparse PS wire hints: unique w-space / V-space rows touched by
@@ -327,6 +331,7 @@ class DifactoLearner:
 
     def refresh_count_mirror(self) -> None:
         self._cnt_host = np.asarray(self.store.state["cnt"]).copy()
+        self._pack_epoch += 1
 
     def on_pass_start(self) -> None:
         """Solver hook: resync the count mirror from the device table so
@@ -708,6 +713,48 @@ class DifactoLearner:
 
         return pred_fn
 
+    # -- epoch pack cache ----------------------------------------------------
+    #: bump when prepare_batch's output layout changes for identical input
+    _PACK_VERSION = 1
+
+    def pack_cache_token(self, train: bool = True):
+        """See LinearLearner.pack_cache_token. The compact FM train pack
+        is NOT bit-identically replayable: admission depends on the
+        evolving count mirror AND packing mutates it (_pack_fm), so a
+        replayed pack would both be stale and skip the count push —
+        decline with None. Eval packs are pure given a mirror snapshot,
+        keyed by the pack-epoch counter that advances on every mirror
+        resync. The XLA fallback path packs with no host state at all
+        and caches for both."""
+        cfg = self.cfg
+        base = ("difacto", self._PACK_VERSION, self._use_fm_pallas,
+                cfg.minibatch, cfg.nnz_per_row, cfg.num_buckets, cfg.vb,
+                cfg.dim, cfg.threshold, cfg.l1_shrk)
+        if not self._use_fm_pallas:
+            return base
+        if train:
+            return None
+        if self._fm_caps is None:
+            return None  # slot caps not yet sized from a first batch
+        return base + (self._fm_caps, self._pack_epoch,
+                       ck.TILE, ck.BLK_U, ck.TILE_HI, ck.FM_BLK,
+                       ck.LANES)
+
+    # -- double-buffered device feed -----------------------------------------
+    def stage_batch(self, b, train: bool = True):
+        """Loader-side device placement. The compact FM pack already
+        device_puts its args in prepare_batch; only the XLA fallback
+        still carries host arrays, so stage those here."""
+        b = self._prepared(b, train)
+        if b[0] != "xla":
+            return b
+        db, size = b[1], b[2]
+        ids = None
+        if train and self.track_touched:
+            ids_w = np.unique(db.idx[db.val != 0]).astype(np.int64)
+            ids = (ids_w, ids_w % self.cfg.vb)
+        return ("xla_staged", self._xla_args(db), size, train, ids)
+
     def _prepared(self, blk, train: bool):
         if isinstance(blk, RowBlock):
             return self.prepare_batch(blk, train=train)
@@ -726,6 +773,11 @@ class DifactoLearner:
             args = b[1]
             self.store.state, self.vstore.state, prog = self._fm_steps[0](
                 self.store.state, self.vstore.state, *args, sub)
+            if self.track_touched:
+                self._note_touched(b[4])
+        elif b[0] == "xla_staged":
+            self.store.state, self.vstore.state, prog = self._train_step(
+                self.store.state, self.vstore.state, *b[1], sub)
             if self.track_touched:
                 self._note_touched(b[4])
         else:
@@ -782,6 +834,10 @@ class DifactoLearner:
             args, size = b[1], b[2]
             margin, prog = self._fm_steps[1](
                 self.store.state, self.vstore.state, *args)
+        elif b[0] == "xla_staged":
+            size = b[2]
+            margin, prog = self._fwd(self.store.state, self.vstore.state,
+                                     *b[1])
         else:
             size = b[2]
             margin, prog = self._fwd(self.store.state, self.vstore.state,

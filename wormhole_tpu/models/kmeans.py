@@ -24,9 +24,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from wormhole_tpu.data import pack_cache as _pc
 from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
 from wormhole_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
-from wormhole_tpu.solver.workload import iter_rowblocks
+from wormhole_tpu.solver.workload import iter_parts, iter_rowblocks
 
 
 @dataclasses.dataclass
@@ -81,6 +82,9 @@ class KmeansLearner:
         self._bsh = batch_sharding(self.mesh, 1)
         self.centroids: Optional[jax.Array] = None  # [k, d], row-normalized
         self.start_iter = 0
+        # epoch pack cache (data/pack_cache.py): None unless enabled by
+        # env — the Lloyd loop replays identical batches every iteration
+        self.pack_cache = _pc.from_env()
 
         k, d, B = cfg.num_clusters, cfg.dim, cfg.minibatch
         self._use_sparse = cfg.assign_kernel == "sparse" or (
@@ -193,7 +197,8 @@ class KmeansLearner:
 
     def pack_batch(self, seg, idx, val):
         """Host-side pack for the flat-bucket densify kernel (numpy, on
-        the loader threads)."""
+        the loader threads; device transfer happens at consumption so
+        the pack output stays cacheable)."""
         from wormhole_tpu.ops import coo_kernels as ck
 
         flat = (np.asarray(seg, np.int64) * self._flat_stride
@@ -201,22 +206,56 @@ class KmeansLearner:
         cap = self.cfg.minibatch * self.cfg.nnz_per_row
         p = ck.pack_sorted_coo(flat, seg, val, self._num_flat,
                                capacity=cap)
-        j = jnp.asarray
-        return (j(p.idx), j(p.seg), j(p.val), j(p.tmap), j(p.first))
+        return (p.idx, p.seg, p.val, p.tmap, p.first)
 
     # -- data plumbing ------------------------------------------------------
-    def _host_batches(self, seed=0):
+    # The Lloyd loop re-reads the SAME batches every iteration (the seed
+    # only matters to shuffle/negative sampling, both off here), which
+    # makes k-means the ideal epoch-cache client: iteration 2+ replays
+    # prepared batches from the cache instead of re-parsing and
+    # re-packing. The loop runs per part so the cache keys whole parts.
+
+    #: bump when _prep_db / pack_batch output layout changes
+    _PACK_VERSION = 1
+
+    def _part_key(self, f, mode: str):
+        from wormhole_tpu.ops import coo_kernels as ck
+
         cfg = self.cfg
-        for blk in iter_rowblocks(cfg.train_data, cfg.num_parts_per_file,
-                                  cfg.data_format, cfg.minibatch,
-                                  node="kmeans", seed=seed):
-            if blk.nnz and int(blk.index.max()) >= cfg.dim:
-                raise ValueError(
-                    f"feature id {int(blk.index.max())} >= dim "
-                    f"{cfg.dim}; set dim=0 to auto-discover")
-            yield to_device_batch(blk, cfg.minibatch,
-                                  cfg.minibatch * cfg.nnz_per_row,
-                                  cfg.dim)
+        return ("kmeans", self._PACK_VERSION, mode, cfg.dim,
+                cfg.minibatch, cfg.nnz_per_row, self._flat_stride,
+                self._num_flat, ck.TILE, ck.BLK, ck.LANES,
+                f.filename, f.part, f.num_parts, cfg.data_format,
+                _pc.file_stamp(f.filename))
+
+    def _prep_db(self, blk: RowBlock):
+        cfg = self.cfg
+        if blk.nnz and int(blk.index.max()) >= cfg.dim:
+            raise ValueError(
+                f"feature id {int(blk.index.max())} >= dim "
+                f"{cfg.dim}; set dim=0 to auto-discover")
+        return to_device_batch(blk, cfg.minibatch,
+                               cfg.minibatch * cfg.nnz_per_row, cfg.dim)
+
+    def _host_dbs(self, mode: str, prep):
+        """Per-part cached DeviceBatch/packed stream; with no cache
+        configured this is exactly the old flat loop."""
+        from wormhole_tpu.data.minibatch import MinibatchIter
+
+        cfg = self.cfg
+        for f in iter_parts(cfg.train_data, cfg.num_parts_per_file,
+                            cfg.data_format, node="kmeans"):
+            def raw(f=f):
+                return MinibatchIter(f.filename, f.part, f.num_parts,
+                                     f.format,
+                                     minibatch_size=cfg.minibatch)
+            key = (self._part_key(f, mode)
+                   if self.pack_cache is not None else None)
+            yield from _pc.iter_part_cached(self.pack_cache, key,
+                                            raw, prep)
+
+    def _host_batches(self, seed=0):
+        yield from self._host_dbs("raw", self._prep_db)
 
     def _batches(self, seed=0):
         for db in self._host_batches(seed):
@@ -227,9 +266,13 @@ class KmeansLearner:
     def _batches_packed(self, seed=0):
         """(packed flat-bucket COO, mask) pairs for the fast dense
         path."""
-        for db in self._host_batches(seed):
-            yield (self.pack_batch(db.seg, db.idx, db.val),
-                   jax.device_put(db.row_mask, self._bsh))
+        def prep(blk):
+            db = self._prep_db(blk)
+            return (self.pack_batch(db.seg, db.idx, db.val), db.row_mask)
+
+        for pk, mask in self._host_dbs("packed", prep):
+            yield (tuple(jnp.asarray(a) for a in pk),
+                   jax.device_put(mask, self._bsh))
 
     # -- init: random rows (kmeans.cc:89-106) -------------------------------
     def init_centroids(self) -> None:

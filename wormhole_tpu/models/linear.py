@@ -586,12 +586,67 @@ class LinearLearner:
             x = self.prepare_batch(x)
         return x
 
+    # -- epoch pack cache ----------------------------------------------------
+    #: bump when prepare_batch's output layout changes for identical input
+    _PACK_VERSION = 1
+
+    def pack_cache_token(self, train: bool = True):
+        """Everything (beyond the raw batch bytes) that decides what
+        prepare_batch emits, or None while that is still undecided. The
+        compact-path decision is made lazily from the first batch
+        (ensure_compact), so until `_compact_cap` resolves the pack
+        output is not yet a pure function of the key — the first cold
+        part simply goes uncached and caching engages from the next
+        part on."""
+        if self._compact_cap is None:
+            return None
+        cfg = self.cfg
+        return ("linear", self._PACK_VERSION, self.use_pallas,
+                self._mesh_coo, self._compact_cap, self._shard_cap,
+                cfg.minibatch, cfg.nnz_per_row, cfg.num_buckets,
+                self.mesh.shape.get("data", 1),
+                self.mesh.shape.get("model", 1),
+                ck.TILE, ck.BLK, ck.BLK_U, ck.LANES)
+
+    # -- double-buffered device feed -----------------------------------------
+    def stage_batch(self, b, train: bool = True):
+        """Move a prepared batch's arrays to the device from the loader
+        thread, so the host->device transfer of batch N+1 overlaps the
+        main thread's step on batch N. Returns a staged tuple that
+        train_batch/eval_batch consume without further transfers. The
+        `train` flag must match the consuming step (tcoo ships the COO
+        stream + update-block bounds only for training)."""
+        b = self._prepared(b)
+        if b[0] == "staged":
+            return b
+        kind, size = b[0], b[-1]
+        # touched-id extraction needs the host arrays; grab it now
+        # because after staging only device arrays remain
+        ids = self._touched_ids(b) if (train and self.track_touched) \
+            else None
+        if kind == "mcoo":
+            _, mc, label, mask, _ = b
+            args = tuple(self._mcoo_args(mc, label, mask))
+        elif kind == "tcoo":
+            _, tc, label, mask, _ = b
+            args = tuple(self._tcoo_args(tc, label, mask, train=train))
+        elif kind == "coo":
+            _, p, label, mask, _ = b
+            args = tuple(self._coo_args(p, label, mask))
+        else:
+            db = b[1]
+            args = self._shard(db.seg, db.idx, db.val, db.label,
+                               db.row_mask)
+        return ("staged", kind, args, size, ids, train)
+
     # -- sparse PS wire hints ------------------------------------------------
-    def _note_touched(self, b) -> None:
-        """Record the unique buckets a trained batch touched, extracted
-        from the prepared batch's host arrays (the sparse PS push set;
-        reference ZPush of the minibatch's keys, async_sgd.h:270-287)."""
+    def _touched_ids(self, b) -> Optional[np.ndarray]:
+        """Unique buckets a prepared batch touches, from its host arrays
+        (the sparse PS push set; reference ZPush of the minibatch's keys,
+        async_sgd.h:270-287). None = unknown (forces a full delta scan)."""
         kind = b[0]
+        if kind == "staged":
+            return b[4]
         if kind == "xla":
             db = b[1]
             ids = np.unique(db.idx[db.val != 0])
@@ -602,10 +657,12 @@ class LinearLearner:
             u = b[1].uniq
             ids = u[u < self.cfg.num_buckets]
         else:  # mcoo holds shard-local layouts; fall back to the scan
-            ids = None
+            return None
+        return ids.astype(np.int64)
+
+    def _note_touched(self, b) -> None:
         with self._touched_lock:
-            self._touched.append(
-                None if ids is None else ids.astype(np.int64))
+            self._touched.append(self._touched_ids(b))
 
     def collect_touched(self):
         """Sorted-unique global rows touched since the last call, per
@@ -624,6 +681,16 @@ class LinearLearner:
         b = self._prepared(blk)
         if self.track_touched:
             self._note_touched(b)
+        if b[0] == "staged":
+            _, kind, args, _, _, st_train = b
+            assert st_train, "batch was staged for eval, not train"
+            step = {"mcoo": self._train_step_mcoo,
+                    "coo": self._train_step_coo,
+                    "xla": self._train_step}.get(kind)
+            if step is None:  # tcoo builds lazily
+                step = self._tcoo_steps[0]
+            self.store.state, prog = step(self.store.state, *args)
+            return jax.tree_util.tree_map(float, prog)
         if b[0] == "mcoo":
             _, mc, label, mask, _ = b
             self.store.state, prog = self._train_step_mcoo(
@@ -646,6 +713,16 @@ class LinearLearner:
 
     def eval_batch(self, blk) -> dict:
         b = self._prepared(blk)
+        if b[0] == "staged":
+            _, kind, args, _, _, st_train = b
+            assert not st_train, "batch was staged for train, not eval"
+            step = {"mcoo": self._eval_step_mcoo,
+                    "coo": self._eval_step_coo,
+                    "xla": self._eval_step}.get(kind)
+            if step is None:
+                step = self._tcoo_steps[1]
+            prog = step(self.store.state, *args)
+            return jax.tree_util.tree_map(float, prog)
         if b[0] == "mcoo":
             _, mc, label, mask, _ = b
             prog = self._eval_step_mcoo(

@@ -25,12 +25,83 @@ from typing import Callable, Optional
 import numpy as np
 
 from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.data import pack_cache as _pc
 from wormhole_tpu.obs import report as _report
 from wormhole_tpu.obs import trace as _trace
+from wormhole_tpu.obs.metrics import REGISTRY
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import WorkloadPool, WorkType
 from wormhole_tpu.utils import checkpoint as ckpt
 from wormhole_tpu.utils.perf import Perf, maybe_trace
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("", "0", "false", "off")
+
+
+class LoaderController:
+    """Stall-driven sizing of the loader thread pool, adjusted between
+    passes (loaders are pass-scoped threads, so the pass is the natural
+    measurement window — the tf.data AUTOTUNE idea with a coarser
+    clock). Inputs per pass, read from the same numbers the obs gauges
+    carry: the main thread's total queue-wait (``loader.stall_s``) and
+    how often the queue was found well-stocked (``queue.depth``).
+
+    Policy (hysteresis keeps it from oscillating):
+    - stall above ``grow_stall`` of wall => the device out-ran the
+      loaders; grow by 1 (by 2 when starved hard, > 3x the threshold);
+    - stall under ``shrink_stall`` AND the queue was >= half full on
+      most gets => loaders are over-provisioned; shrink by 1. The
+      queue-fullness gate stops a shrink when stall is low merely
+      because the pass was short.
+    PERF.md's headline measurement is the motivating data point: 2
+    loader threads starve a ~17 ms device step behind ~100 ms packs,
+    3 restore headroom."""
+
+    def __init__(self, initial: int, lo: int = 1, hi: int | None = None,
+                 grow_stall: float = 0.15, shrink_stall: float = 0.02):
+        self.n = max(int(initial), lo)
+        self.lo = lo
+        # loaders spend most of their time in I/O and GIL-released numpy,
+        # so 2x oversubscription over the cores is a sane ceiling
+        self.hi = hi if hi is not None else max(2 * (os.cpu_count() or 2),
+                                                self.n)
+        self.grow_stall = grow_stall
+        self.shrink_stall = shrink_stall
+        self.decisions: list[dict] = []
+
+    def record_pass(self, stall_s: float, wall_s: float, n_steps: int,
+                    queue_high_frac: float) -> int:
+        """Fold one pass's numbers in; returns the pool size to use for
+        the next pass. Passes too short to be a signal (< 4 steps) leave
+        the size unchanged."""
+        stall_frac = stall_s / max(wall_s, 1e-9)
+        new = self.n
+        why = "steady"
+        if n_steps >= 4:
+            if stall_frac > self.grow_stall:
+                step = 2 if stall_frac > 3 * self.grow_stall else 1
+                new = min(self.n + step, self.hi)
+                why = "starved"
+            elif stall_frac < self.shrink_stall and queue_high_frac > 0.5:
+                new = max(self.n - 1, self.lo)
+                why = "overfed"
+        self.decisions.append({
+            "from": self.n, "to": new, "why": why,
+            "stall_frac": round(stall_frac, 4),
+            "queue_high_frac": round(queue_high_frac, 3),
+            "n_steps": n_steps,
+        })
+        self.n = new
+        return new
+
+
+_QDEPTH = REGISTRY.gauge("queue.depth")
+_STALL = REGISTRY.gauge("loader.stall_s")
+_POOL = REGISTRY.gauge("loader.pool_size")
 
 
 class MinibatchSolver:
@@ -43,21 +114,50 @@ class MinibatchSolver:
 
     def __init__(self, learner, cfg, num_loaders: int | None = None,
                  max_queued: int = 8, verbose: bool = True):
+        src = "arg"
+        pinned = num_loaders is not None
         if num_loaders is None:
-            # the reference's max_concurrency knob (minibatch_solver.h:
-            # 215-242): concurrently-prepared in-flight minibatches
-            num_loaders = getattr(cfg, "max_concurrency", 2)
+            env = os.environ.get("WH_NUM_LOADERS")
+            if env:
+                # hardware sweeps pin the pool without config edits
+                num_loaders = max(1, int(env))
+                src = "WH_NUM_LOADERS"
+                pinned = True
+            else:
+                # the reference's max_concurrency knob (minibatch_solver.h:
+                # 215-242): concurrently-prepared in-flight minibatches
+                num_loaders = getattr(cfg, "max_concurrency", 2)
+                src = "cfg.max_concurrency"
         self.learner = learner
         self.cfg = cfg
         self.num_loaders = num_loaders
         self.max_queued = max_queued
         self.verbose = verbose
         self.t0 = time.time()
+        # adaptive sizing defaults on, but a pinned count (explicit arg or
+        # env) means the operator chose — stay fixed unless they also set
+        # WH_ADAPTIVE_LOADERS=1
+        self.controller: Optional[LoaderController] = (
+            LoaderController(num_loaders)
+            if _env_flag("WH_ADAPTIVE_LOADERS", default=not pinned)
+            else None)
+        self.pack_cache = _pc.from_env()
+        # loader-side device staging (double-buffer): batch N+1's arrays
+        # go to the device while the main thread steps batch N
+        self.device_feed = _env_flag("WH_DEVICE_FEED", True)
         # early-stop hook: (pass progress, data_pass, type) -> bool
         self.stop_hook: Optional[Callable] = None
         # per-op perf accounting (reference minibatch_solver.h:246-275 +
         # difacto async_sgd.h:108-127 style)
         self.perf = Perf(log=self._log)
+        cache_desc = "off"
+        if self.pack_cache is not None:
+            cache_desc = f"mem={self.pack_cache.mem_bytes >> 20}MB"
+            if self.pack_cache.disk_dir:
+                cache_desc += f" disk={self.pack_cache.disk_dir}"
+        self._log(f"[loader] {num_loaders} loader thread(s) ({src}), "
+                  f"adaptive={'on' if self.controller else 'off'}, "
+                  f"pack_cache={cache_desc}")
 
     @property
     def _ckpt_store(self):
@@ -109,6 +209,21 @@ class MinibatchSolver:
         return bool(self.stop_hook(result[key], dp, key))
 
     # ------------------------------------------------------------- iterate
+    def _pass_cache_token(self, train: bool):
+        """The learner's pack version for this pass, or None when this
+        pass's batch stream cannot be replayed bit-identically: shuffle
+        and negative sampling draw from a seed that changes per pass, so
+        a cached pack from pass 0 would be the wrong batch in pass 1."""
+        if self.pack_cache is None:
+            return None
+        tok_fn = getattr(self.learner, "pack_cache_token", None)
+        if tok_fn is None:
+            return None
+        if train and (self.cfg.rand_shuffle
+                      or self.cfg.neg_sampling < 1.0):
+            return None
+        return tok_fn(train=train)
+
     def iterate(self, data: str, wtype: WorkType, data_pass: int = 0) -> Progress:
         cfg = self.cfg
         hook = getattr(self.learner, "on_pass_start", None)
@@ -142,6 +257,12 @@ class MinibatchSolver:
                     continue
             return False
 
+        train = wtype == WorkType.TRAIN
+        token = self._pass_cache_token(train)
+        prepare = getattr(self.learner, "prepare_batch", None)
+        stage = (getattr(self.learner, "stage_batch", None)
+                 if self.device_feed else None)
+
         def loader(node_id: int):
             try:
                 while not stop.is_set():
@@ -149,25 +270,40 @@ class MinibatchSolver:
                     if got is None:
                         return
                     part_id, f = got
-                    it = MinibatchIter(
-                        f.filename, f.part, f.num_parts, f.format,
-                        minibatch_size=cfg.minibatch,
-                        shuf_buf=(cfg.rand_shuffle * cfg.minibatch
-                                  if wtype == WorkType.TRAIN else 0),
-                        neg_sampling=(cfg.neg_sampling
-                                      if wtype == WorkType.TRAIN else 1.0),
-                        seed=data_pass * 7919 + part_id,
-                    )
-                    prepare = getattr(self.learner, "prepare_batch", None)
-                    for blk in it:
-                        # host-side batch prep (padding + pallas tile-sort)
-                        # happens here in the loader thread, overlapped with
-                        # the main thread's device steps
-                        if prepare:
-                            with self.perf.timer("prepare"):
-                                blk = prepare(
-                                    blk, train=(wtype == WorkType.TRAIN))
-                        if not _put(blk):
+
+                    def raw_iter(f=f, part_id=part_id):
+                        return MinibatchIter(
+                            f.filename, f.part, f.num_parts, f.format,
+                            minibatch_size=cfg.minibatch,
+                            shuf_buf=(cfg.rand_shuffle * cfg.minibatch
+                                      if train else 0),
+                            neg_sampling=(cfg.neg_sampling
+                                          if train else 1.0),
+                            seed=data_pass * 7919 + part_id,
+                        )
+
+                    def prep(blk):
+                        # host-side batch prep (padding + pallas
+                        # tile-sort) happens here in the loader thread,
+                        # overlapped with the main thread's device steps
+                        if prepare is None:
+                            return blk
+                        with self.perf.timer("prepare"):
+                            return prepare(blk, train=train)
+
+                    # identical (token, part, file bytes, batch geometry)
+                    # => identical pack; anything else misses
+                    part_key = None
+                    if token is not None:
+                        part_key = (
+                            "train" if train else "eval", token,
+                            f.filename, f.part, f.num_parts, f.format,
+                            cfg.minibatch, _pc.file_stamp(f.filename))
+                    for b in _pc.iter_part_cached(
+                            self.pack_cache, part_key, raw_iter, prep):
+                        if stage is not None:
+                            b = stage(b, train=train)
+                        if not _put(b):
                             return
                     pool.finish(part_id)
             except BaseException as e:
@@ -175,9 +311,11 @@ class MinibatchSolver:
             finally:
                 _put(_END)
 
+        n_loaders = self.controller.n if self.controller else self.num_loaders
+        _POOL.set(n_loaders)
         threads = [
             threading.Thread(target=loader, args=(i,), daemon=True)
-            for i in range(self.num_loaders)
+            for i in range(n_loaders)
         ]
         for t in threads:
             t.start()
@@ -189,6 +327,9 @@ class MinibatchSolver:
         last_print = time.time()
         n_steps = 0
         t_step = 0.0
+        stall_s = 0.0
+        gets = 0
+        high = 0
         t_pass0 = time.perf_counter()
         if self.verbose:
             self._log(f"{mode} pass {data_pass}: {data}")
@@ -197,9 +338,17 @@ class MinibatchSolver:
             with _trace.span(f"{mode}_pass", cat="solver",
                              data_pass=data_pass):
                 while done_loaders < len(threads):
+                    depth = q.qsize()
+                    _QDEPTH.set(depth)
+                    gets += 1
+                    if depth >= max(1, self.max_queued // 2):
+                        high += 1
                     t_w = time.perf_counter()
                     item = q.get()
-                    self.perf.add("wait", time.perf_counter() - t_w)
+                    dw = time.perf_counter() - t_w
+                    self.perf.add("wait", dw)
+                    stall_s += dw
+                    _STALL.set(stall_s)
                     if item is _END:
                         done_loaders += 1
                         continue
@@ -222,17 +371,36 @@ class MinibatchSolver:
             raise errors[0]
         if self.verbose:
             self._log(prog.row(self.t0))
+        wall = time.perf_counter() - t_pass0
+        self.last_pass_stall_s = stall_s
+        self.last_pass_wall_s = wall
         if n_steps:
             # FinishMinibatch-style pass summary (minibatch_solver.h:
             # 246-275): average device-step time and the share of wall
             # time spent outside compute (I/O + parse + any PS sync)
-            wall = time.perf_counter() - t_pass0
             overhead = max(0.0, 100.0 * (1.0 - t_step / max(wall, 1e-9)))
             self._log(
                 f"{mode} pass {data_pass}: {n_steps} minibatches, "
                 f"avg {1e3 * t_step / n_steps:.1f}ms/step, "
                 f"{overhead:.0f}% io/comm overhead, "
                 f"wall {wall:.2f}s")
+        if self.pack_cache is not None:
+            s = self.pack_cache.stats()
+            self._log(
+                f"[loader] pack cache: {s['hits']} hits / "
+                f"{s['misses']} misses ({100 * s['hit_rate']:.0f}%), "
+                f"mem {s['mem_bytes'] >> 20}MB/{s['mem_entries']} entries")
+        if self.controller is not None:
+            self.controller.record_pass(
+                stall_s, wall, n_steps, high / max(gets, 1))
+            d = self.controller.decisions[-1]
+            if d["from"] != d["to"]:
+                self._log(
+                    f"[loader] controller: {d['from']} -> {d['to']} "
+                    f"loaders ({d['why']}, stall "
+                    f"{100 * d['stall_frac']:.0f}% of wall, queue "
+                    f">=half-full {100 * d['queue_high_frac']:.0f}% "
+                    f"of gets)")
         return prog
 
     # ------------------------------------------------------------- predict

@@ -66,13 +66,23 @@ def iter_rowblocks(pattern: str, num_parts_per_file: int = 1,
     kmeans.cc:149-154, lbfgs.cc:229-234)."""
     from wormhole_tpu.data.minibatch import MinibatchIter
 
+    for f in iter_parts(pattern, num_parts_per_file, fmt, node):
+        yield from MinibatchIter(f.filename, f.part, f.num_parts, f.format,
+                                 minibatch_size=minibatch_size, seed=seed)
+
+
+def iter_parts(pattern: str, num_parts_per_file: int = 1,
+               fmt: str = "libsvm", node: str = "loader"):
+    """Yield the File parts `pattern` expands to, through the same
+    one-shot pool.add -> get -> finish protocol — for callers that need
+    the part boundary itself (e.g. per-part pack-cache keys) rather
+    than a flat RowBlock stream."""
     pool = WorkloadPool()
     if pool.add(pattern, num_parts_per_file, fmt) == 0:
         raise FileNotFoundError(f"no files match {pattern}")
     while (got := pool.get(node)) is not None:
         part_id, f = got
-        yield from MinibatchIter(f.filename, f.part, f.num_parts, f.format,
-                                 minibatch_size=minibatch_size, seed=seed)
+        yield f
         pool.finish(part_id)
 
 
