@@ -369,19 +369,29 @@ print_sec = 3600
 
         # the distributed run also records its obs telemetry so the
         # BENCH row carries wire volume + RPC quantiles alongside the
-        # throughput (run_report.json, wormhole_tpu/obs/report.py)
-        obs_dir = f"{td}/obs_dist"
-        r = run_group(
-            [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
-             "-n", "1", "-s", "1", "--",
-             sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
-            timeout=600, extra_env={"WH_OBS_DIR": obs_dir})
-        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
-        m = re.search(r"\[ps-wire\] (\{.*\})", r.stdout)
-        assert m, r.stdout[-2000:]
-        wire = json.loads(m.group(1))
-        dist_eps = wire["last_round_nex"] / max(wire["last_round_sec"],
-                                                1e-9)
+        # throughput (run_report.json, wormhole_tpu/obs/report.py).
+        # Two runs: the production operating point (async overlapped
+        # sync + key caching) and the plain synchronous plane, so the
+        # row shows the overlap/caching gain, not just one number.
+        def run_dist(tag, async_sync):
+            obs_dir = f"{td}/obs_dist_{tag}"
+            flag = "1" if async_sync else "0"
+            r = run_group(
+                [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+                 "-n", "1", "-s", "1", "--",
+                 sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
+                timeout=600, extra_env={"WH_OBS_DIR": obs_dir,
+                                        "WH_ASYNC_SYNC": flag,
+                                        "WH_KEYCACHE": flag})
+            assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+            m = re.search(r"\[ps-wire\] (\{.*\})", r.stdout)
+            assert m, r.stdout[-2000:]
+            w = json.loads(m.group(1))
+            eps = w["last_round_nex"] / max(w["last_round_sec"], 1e-9)
+            return w, eps, obs_dir
+
+        wire, dist_eps, obs_dir = run_dist("async", True)
+        wire_off, dist_eps_off, _ = run_dist("sync", False)
         obs = None
         try:
             with open(f"{obs_dir}/run_report.json") as fh:
@@ -389,7 +399,8 @@ print_sec = 3600
             obs = {k: s.get(k) for k in (
                 "num_push", "num_pull", "bytes_pushed", "bytes_pulled",
                 "net_bytes_sent", "net_bytes_recv",
-                "rpc_p50_ms", "rpc_p99_ms")}
+                "rpc_p50_ms", "rpc_p99_ms",
+                "keycache_hits", "keycache_misses")}
         except (OSError, KeyError, json.JSONDecodeError):
             pass  # telemetry riding along must not fail the bench
 
@@ -404,7 +415,8 @@ print_sec = 3600
 
     # dense wire at this operating point: push z+n deltas, pull w+z+n
     dense_bytes = 5 * num_buckets * 4
-    return dist_eps, single_eps, wire, dense_bytes, obs
+    return dist_eps, dist_eps_off, single_eps, wire, wire_off, \
+        dense_bytes, obs
 
 
 # ---------------------------------------------------------------- kmeans
@@ -541,18 +553,31 @@ def main():
              eps, "examples/sec", eps / BASELINE_EXAMPLES_PER_SEC)
     got = _safe("linear_ps", bench_linear_ps)
     if got is not None:
-        dist_eps, single_eps, wire, dense_bytes, obs = got
+        (dist_eps, dist_eps_off, single_eps, wire, wire_off,
+         dense_bytes, obs) = got
         # vs_baseline here = ratio to the single-process run on the same
-        # data/platform. On this 1-core box the ratio is dominated by
-        # worker/server/scheduler timesharing of the core: the
-        # design-attributable sync cost is ~90 ms per 50k-example sync
-        # (~7% overhead) measured in-process — see PERF.md "PS plane"
-        # for the full attribution (r4's >= 0.77 bar conflated the two)
+        # data/platform; the recorded run is the production operating
+        # point (WH_ASYNC_SYNC=1 WH_KEYCACHE=1), async_off_eps the plain
+        # synchronous plane on the same data — see PERF.md "PS plane"
         emit("linear_ftrl_ps_dist_64m_buckets_examples_per_sec", dist_eps,
-             "examples/sec", dist_eps / single_eps, obs=obs)
-        # vs_baseline = fraction of what a dense-table sync would move
+             "examples/sec", dist_eps / single_eps, obs=obs,
+             async_off_eps=round(dist_eps_off, 1),
+             ps_sync_overlap_frac=wire.get("sync_overlap_frac"),
+             ps_push_ms_per_sync=wire.get("push_ms_per_sync"),
+             ps_pull_ms_per_sync=wire.get("pull_ms_per_sync"),
+             keycache_hit_rate=wire.get("keycache_hit_rate"))
+        # vs_baseline = fraction of what a dense-table sync would move;
+        # the saving field compares the LAST train round (epoch 2, where
+        # the key cache ships digest-only frames) against the cache-off
+        # run at the same operating point
+        kc_on = wire.get("last_round_bytes_per_sync") or 0
+        kc_off = wire_off.get("last_round_bytes_per_sync") or 0
         emit("ps_wire_bytes_per_sync_64m_buckets", wire["bytes_per_sync"],
-             "bytes", wire["bytes_per_sync"] / dense_bytes)
+             "bytes", wire["bytes_per_sync"] / dense_bytes,
+             epoch2_bytes_per_sync=kc_on,
+             epoch2_bytes_per_sync_nocache=kc_off,
+             keycache_saving_frac=round(1.0 - kc_on / kc_off, 4)
+             if kc_off else None)
     got = _safe("linear_epoch2", bench_linear_epoch2, NUM_BUCKETS, MINIBATCH)
     if got is not None:
         eps, stall, wall, hit = got

@@ -1,0 +1,428 @@
+"""Overlapped async PS sync + key-list caching (runtime/ps_server.py):
+the ps-lite ZPush/ZPull-return-immediately semantics rebuilt as
+SyncedStore's background comms thread, and the KEY_CACHING filter as
+blake2b key-list digests with a miss -> full-resend fallback. Covers
+the async/sync equivalence contract, the 2*max_delay staleness bound,
+cache hit/miss/invalidation protocol, and recovery (kill, net:reset)
+with a round-trip in flight."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime.ps_server import (
+    PSClient, ServerNode, SyncedStore,
+)
+
+
+class _FakeStore:
+    """to_numpy/from_numpy/gather/scatter duck type standing in for a
+    KVStore (host numpy)."""
+
+    def __init__(self, tables):
+        self.tables = {k: np.array(v, np.float32) for k, v in tables.items()}
+
+    def to_numpy(self):
+        return {k: v.copy() for k, v in self.tables.items()}
+
+    def from_numpy(self, arrays):
+        for k, v in arrays.items():
+            self.tables[k] = np.array(v, np.float32)
+
+    def gather_rows(self, k, idx):
+        return self.tables[k][idx]
+
+    def scatter_rows(self, k, idx, vals):
+        self.tables[k][idx] = vals
+
+
+@pytest.fixture
+def group():
+    nodes = [ServerNode(r, 2) for r in range(2)]
+    for n in nodes:
+        n.serve()
+    clients = []
+
+    def mk(**kw):
+        c = PSClient([n.uri for n in nodes], **kw)
+        clients.append(c)
+        return c
+
+    yield nodes, mk
+    for c in clients:
+        c.close()
+    for n in nodes:
+        n.stop()
+
+
+def _hinted(client, n, async_sync, keycache=False, **kw):
+    """A SyncedStore over a fake host store with touched-row hints (the
+    sparse-wire path the distributed runner uses)."""
+    store = _FakeStore({"w": np.zeros(n)})
+    touched = {"rows": np.empty(0, np.int64)}
+
+    def touch(idx, amount):
+        store.tables["w"][idx] += amount
+        touched["rows"] = np.union1d(touched["rows"],
+                                     np.asarray(idx, np.int64))
+
+    def collect():
+        out = {"w": touched["rows"]}
+        touched["rows"] = np.empty(0, np.int64)
+        return out
+
+    ss = SyncedStore(store, client, max_delay=1, touched_fn=collect,
+                     async_sync=async_sync, **kw)
+    return store, touch, ss
+
+
+# ------------------------------------------------------- async semantics
+def test_async_off_is_bit_identical_to_sync_mode(group):
+    """WH_ASYNC_SYNC=0 must be byte-for-byte the pre-async plane: same
+    pushes, same pulls, no comms thread — and a single async worker's
+    flushed end state must equal the sync-mode end state exactly."""
+    nodes, mk = group
+    n = 64
+    rng = np.random.default_rng(3)
+    idxs = [np.unique(rng.integers(0, n, size=12)) for _ in range(6)]
+
+    def run(async_sync, sender):
+        store, touch, ss = _hinted(mk(sender=sender), n, async_sync)
+        ss.init()
+        for it, idx in enumerate(idxs):
+            touch(idx, float(it + 1))
+            ss.sync()
+        ss.flush()
+        ss.close()
+        return store.tables["w"].copy(), ss
+
+    # NOTE: separate row-spaces would collide on the shared server
+    # tables, so run sync mode first and snapshot the server delta
+    w_sync, ss_sync = run(False, "a0")
+    assert ss_sync._comm_thread is None  # off == the old synchronous path
+    before = mk().pull()["w"].copy()
+    w_async, ss_async = run(True, "a1")
+    after = mk().pull()["w"].copy()
+    # both workers pushed identical deltas: the async run's server-side
+    # contribution equals the sync run's, bit for bit
+    np.testing.assert_array_equal(after - before, before)
+    # and the flushed async mirror holds the merged state exactly
+    np.testing.assert_array_equal(w_async, after)
+
+
+def test_async_bounded_staleness_invariant(group):
+    """At most ONE round-trip is ever in flight, so a pull enqueued at
+    sync k folds by sync k+1: observed fold lag never exceeds 1 sync
+    round == staleness <= 2*max_delay minibatches."""
+    nodes, mk = group
+    n = 32
+    store, touch, ss = _hinted(mk(sender="b0"), n, async_sync=True)
+    ss.init()
+    for it in range(8):
+        touch([it % n, (it * 5) % n], 1.0)
+        ss.sync()
+        assert ss.max_fold_lag <= 1
+    ss.flush()
+    assert ss.max_fold_lag == 1  # the overlap actually happened
+    ss.close()
+
+
+def test_async_two_workers_converge_and_keep_unpushed_progress(group):
+    """The fold algebra: adopting a pulled row must keep local progress
+    made since that row's delta went on the wire
+    (store <- pulled + (cur - base)), so concurrent async workers
+    converge to the exact merged sum."""
+    nodes, mk = group
+    n = 48
+    s1_store, touch1, s1 = _hinted(mk(sender="c0"), n, async_sync=True)
+    s2_store, touch2, s2 = _hinted(mk(sender="c1"), n, async_sync=True)
+    s1.init()
+    s2.init()
+    rng = np.random.default_rng(0)
+    want = np.zeros(n, np.float32)
+    for it in range(6):
+        i1 = np.unique(rng.integers(0, n, size=6))
+        i2 = np.unique(rng.integers(0, n, size=6))
+        touch1(i1, 1.0)
+        want[i1] += 1.0
+        touch2(i2, 10.0)
+        want[i2] += 10.0
+        s1.sync()
+        s2.sync()
+    s1.flush()
+    s2.flush()
+    # flush barriers both workers; one more pull each adopts the other's
+    # final contribution
+    s1.pull()
+    s2.pull()
+    np.testing.assert_allclose(s1_store.tables["w"], want, rtol=1e-6)
+    np.testing.assert_allclose(s2_store.tables["w"], want, rtol=1e-6)
+    s1.close()
+    s2.close()
+
+
+def test_async_fold_overwrites_derived_tables(group):
+    """Derived (non-additive) tables fold by overwrite, like the sync
+    path: after a flush the local w rows equal the server's
+    prox(z, n), not a sum."""
+    nodes, mk = group
+    n = 16
+    store = _FakeStore({k: np.zeros(n) for k in ("w", "z", "n")})
+    touched = {}
+
+    def collect():
+        out = {"z": touched.get("rows", np.empty(0, np.int64)),
+               "n": touched.get("rows", np.empty(0, np.int64))}
+        touched.clear()
+        return out
+
+    spec = {"w": {"kind": "ftrl_prox", "lr_eta": 0.5, "lr_beta": 1.0,
+                  "lambda_l1": 1.0, "lambda_l2": 0.0}}
+    ss = SyncedStore(store, mk(sender="d0"), max_delay=1, derived=spec,
+                     touched_fn=collect, async_sync=True)
+    ss.init()
+    idx = np.array([2, 7, 11], np.int64)
+    for _ in range(3):
+        store.tables["z"][idx] += 1.8
+        store.tables["n"][idx] += 0.25
+        touched["rows"] = idx
+        ss.sync()
+    ss.flush()
+    server = ss.client.pull()
+    np.testing.assert_allclose(store.tables["w"], server["w"], rtol=1e-6)
+    assert np.any(server["w"] != 0)  # prox actually produced weights
+    ss.close()
+
+
+# ------------------------------------------------------------- key cache
+def test_keycache_hit_then_miss_then_full_resend(group):
+    """Protocol walk: repeated touched sets hit the (sender, digest)
+    cache; a server that lost its cache replies need_keys and the
+    client full-resends under a fresh seq — values land exactly once
+    either way."""
+    nodes, mk = group
+    n = 64
+    client = mk(sender="e0", keycache=True)
+    store, touch, ss = _hinted(client, n, async_sync=False, keycache=True)
+    ss.init()
+    idx = np.array([3, 5, 9, 40], np.int64)
+    for _ in range(3):
+        touch(idx, 1.0)
+        ss.sync()
+    assert client.kc_hits > 0 and client.kc_misses == 0
+    # server 0 loses its cache (stands in for a respawn)
+    nodes[0]._kc_idx = {}
+    nodes[0]._kc_known = {}
+    touch(idx, 1.0)
+    ss.sync()
+    assert client.kc_misses >= 1  # need_keys came back
+    got = client.pull()["w"]
+    np.testing.assert_array_equal(got[idx], np.full(4, 4.0, np.float32))
+    ss.close()
+
+
+def test_keycache_steady_state_wire_drops(group):
+    """Same touched set on every sync: once digests are established the
+    wire stops carrying index arrays — bytes/sync drops vs the first
+    (key-shipping) sync."""
+    nodes, mk = group
+    n = 1 << 14
+    client = mk(sender="f0", keycache=True)
+    store, touch, ss = _hinted(client, n, async_sync=False, keycache=True)
+    ss.init()
+    idx = np.arange(0, n, 7, dtype=np.int64)  # ~2340 rows
+    per_sync = []
+    for _ in range(4):
+        touch(idx, 1.0)
+        b0 = client.bytes_push + client.bytes_pull
+        ss.sync()
+        per_sync.append(client.bytes_push + client.bytes_pull - b0)
+    saving = 1.0 - per_sync[-1] / per_sync[0]
+    assert saving >= 0.25, per_sync
+    hit_rate = client.kc_hits / (client.kc_hits + client.kc_misses or 1)
+    assert hit_rate > 0.5
+    ss.close()
+
+
+def test_keycache_invalidated_on_restore_and_recover(group, tmp_path):
+    """Both invalidation edges: a server restoring a snapshot drops its
+    cached key lists, and a client that ran recovery clears its pushed-
+    digest bookkeeping — counted in ps.keycache.invalidations."""
+    nodes, mk = group
+    inv = _obs.REGISTRY.counter("ps.keycache.invalidations")
+    base = inv.value()
+    n = 32
+    client = mk(sender="g0", keycache=True, retry_deadline=10.0)
+    store, touch, ss = _hinted(client, n, async_sync=False, keycache=True)
+    ss.init()
+    touch([1, 2, 3], 1.0)
+    ss.sync()
+    nodes[0]._snap_base = str(tmp_path / "srv")
+    assert nodes[0].snapshot() is not None
+    nodes[0].restore_snapshot(str(tmp_path / "srv"))
+    assert inv.value() > base  # server-side invalidation counted
+    assert not nodes[0]._kc_idx and not nodes[0]._kc_known
+    # client-side: _recover clears per-server digest state
+    base2 = inv.value()
+    client._kc_pushed[0]["deadbeef"] = True
+    client._recover(0, "push", ConnectionError("x"))
+    assert inv.value() > base2
+    assert not client._kc_pushed[0]
+    ss.close()
+
+
+# -------------------------------------------------------------- recovery
+def test_net_reset_during_async_syncs_applies_exactly_once():
+    """Injected connection resets while async round-trips are in
+    flight: the comms thread rides the fenced retry, the journal
+    replays, and every delta lands exactly once."""
+    node = ServerNode(0, 1)
+    node.serve()
+    client = PSClient([node.uri], sender="h0", retry_deadline=15.0,
+                      keycache=True)
+    store, touch, ss = _hinted(client, 32, async_sync=True)
+    ss.init()
+    assert faults.ACTIVE is None
+    faults.ACTIVE = faults.Faults("net:reset:after_frames=4",
+                                  role="worker")
+    try:
+        for it in range(5):
+            touch([1, 2, 17], 1.0)
+            ss.sync()
+        ss.flush()
+    finally:
+        faults.ACTIVE = None
+    assert client.num_retries >= 1
+    got = client.pull()["w"]
+    np.testing.assert_array_equal(got[[1, 2, 17]],
+                                  np.full(3, 5.0, np.float32))
+    ss.close()
+    client.close()
+    node.stop()
+
+
+def test_server_kill_during_inflight_async_sync(tmp_path):
+    """A server dies with an async round-trip in flight and respawns
+    from its snapshot: the pending sync retries through hello + journal
+    replay, the rollback forces a since=0 re-pull, the key cache is
+    invalidated, and no delta is lost or doubled."""
+    inv = _obs.REGISTRY.counter("ps.keycache.invalidations")
+    inv0 = inv.value()
+    base = str(tmp_path / "srv")
+    node = ServerNode(0, 1)
+    node._snap_base = base
+    node.serve()
+    holder = {"uris": None}
+    client = PSClient([node.uri], sender="k0", retry_deadline=20.0,
+                      keycache=True, resolver=lambda: holder["uris"])
+    store, touch, ss = _hinted(client, 32, async_sync=True)
+    ss.init()
+    touch([1, 2], 1.0)
+    ss.sync()
+    ss.flush()                      # seq'd pushes now on the server
+    assert node.snapshot() is not None
+    touch([3], 1.0)
+    # kill the server the moment the comms thread's push frame arrives,
+    # then respawn it from the snapshot (missing the in-flight delta —
+    # the journal must replay it)
+    killed = threading.Event()
+    orig = node._dispatch
+
+    def dying(header, arrays):
+        if header.get("op") == "push" and not killed.is_set():
+            killed.set()
+            node.stop()             # connection dies mid-RPC
+            raise ConnectionError("server killed by test")
+        return orig(header, arrays)
+
+    node._dispatch = dying
+    ss.sync()                       # enqueue; comms thread hits the kill
+
+    assert killed.wait(10)
+    node2 = ServerNode(0, 1, epoch=1)
+    assert node2.restore_snapshot(base)
+    node2.serve()
+    holder["uris"] = [node2.uri]
+
+    touch([4], 1.0)
+    ss.sync()                       # folds the retried pull first
+    ss.flush()
+    assert client.num_retries >= 1
+    want = np.zeros(32, np.float32)
+    want[[1, 2, 3, 4]] = 1.0
+    np.testing.assert_array_equal(client.pull()["w"], want)
+    np.testing.assert_array_equal(store.tables["w"], want)
+    assert inv.value() > inv0       # recovery invalidated the key cache
+    ss.close()
+    client.close()
+    node2.stop()
+
+
+# ------------------------------------------- group-union regression (sat)
+def test_union_groups_matches_repeated_union1d():
+    """_scan_groups/_touched_groups build per-group index unions with a
+    single concatenate+unique; must equal the old repeated-np.union1d
+    fold for any mix of shared/disjoint per-table sets."""
+    rng = np.random.default_rng(11)
+    shared = np.unique(rng.integers(0, 1000, size=64))
+    parts = [shared,                      # identical object (fast path)
+             np.unique(rng.integers(0, 1000, size=32)),
+             np.unique(rng.integers(500, 1500, size=48)),
+             np.empty(0, np.int64)]
+    want = np.empty(0, np.int64)
+    for p in parts:
+        want = np.union1d(want, p)
+    got = SyncedStore._union_groups({1500: parts})[1500]
+    np.testing.assert_array_equal(got, want)
+    # identical-hint fast path returns the hint array itself (no copy)
+    same = SyncedStore._union_groups({1000: [shared, shared]})[1000]
+    assert same is shared
+
+
+def test_scan_groups_union_end_to_end(group):
+    """Full-scan fallback with two tables in one row-space group: the
+    pushed union must cover both tables' dirty rows exactly."""
+    nodes, mk = group
+    n = 40
+    store = _FakeStore({"a": np.zeros(n), "b": np.zeros(n)})
+    ss = SyncedStore(store, mk(sender="u0"), max_delay=1)
+    ss.init()
+    store.tables["a"][[3, 7]] += 1.0
+    store.tables["b"][[7, 30]] += 2.0
+    groups, deltas = ss._scan_groups()
+    np.testing.assert_array_equal(groups[n], np.array([3, 7, 30]))
+    np.testing.assert_allclose(deltas["a"], [1.0, 1.0, 0.0])
+    np.testing.assert_allclose(deltas["b"], [0.0, 2.0, 2.0])
+    ss.close()
+
+
+# --------------------------------------------------------- slow-tier lab
+@pytest.mark.slow
+def test_ps_lab_reports_all_stages():
+    """tools/ps_lab.py runs end to end on CPU and reports a ms/sync
+    figure for every PS stage plus the composed sync/async loops."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "tools/ps_lab.py", "--buckets", str(1 << 16),
+         "--nnz", "5000", "--syncs", "3", "--compute-ms", "10",
+         "--json"],
+        capture_output=True, text=True, timeout=240, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = [json.loads(l) for l in r.stdout.splitlines() if l.strip()]
+    stages = {row["stage"] for row in rows}
+    assert {"gather", "encode", "merge", "pull_read", "pull_apply",
+            "wire", "sync_total", "keycache", "sync_loop",
+            "async_loop"} <= stages
+    kc = next(row for row in rows if row["stage"] == "keycache")
+    assert kc["saving_frac"] > 0 and kc["hit_rate"] > 0.5
+    al = next(row for row in rows if row["stage"] == "async_loop")
+    assert al["overlap_frac"] >= 0.0

@@ -19,10 +19,15 @@ its run_report.json feeds the verdict (wormhole_tpu/obs):
     recoveries / ps retries) — a clean logloss with no recovery
     observed means the fault was absorbed by accident, not by design;
   - a connection-reset scenario (no server death, so no state was
-    lost) must show journal_replays == replay_dedup_hits: every
-    replayed push dup-acked by the seq fence. An un-deduped replay is
-    a double-applied gradient — flagged SILENT-CORRUPTION even when
-    the logloss happens to land within --tol.
+    lost) must show every JOURNALED replay dup-acked by the seq fence
+    (entries are journaled only after their ack, so the server already
+    applied them). The push that was in flight when the reset hit is
+    the one exception: the reset can cut its request mid-delivery, in
+    which case the fenced retry is the server's FIRST sight of it and
+    applies fresh — and there is at most one such push per reconnect.
+    So the invariant is un-deduped replays <= ps retries; more than
+    that is a double-applied gradient — flagged SILENT-CORRUPTION
+    even when the logloss happens to land within --tol.
 
 The matrix also prints each scenario's metric deltas vs the unfaulted
 baseline (retries, replays, dedups, restores) so a recovery-path
@@ -36,7 +41,7 @@ fault, just slowness — must stay bit-identical survived).
 
 Usage:
   JAX_PLATFORMS=cpu python tools/chaos_lab.py
-  python tools/chaos_lab.py --specs "server:0:kill@push:60" --restarts 2
+  python tools/chaos_lab.py --specs "server:0:kill@push:30" --restarts 2
   python tools/chaos_lab.py --no-recovery   # verify fail-fast still fails
 
 Each scenario is a fresh launcher subprocess, so a hard server exit
@@ -60,7 +65,7 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_SPECS = [
-    "server:0:kill@push:60",
+    "server:0:kill@push:30",
     "server:0:kill@pull:25",
     "net:reset:after_frames=50",
     "net:delay:ms=2",
@@ -88,12 +93,17 @@ def synth_libsvm(path: str, n_rows: int, seed: int, n_feat: int = 1000,
 
 def run_job(conf: str, spec: str, workers: int, servers: int,
             restarts: int, timeout: float,
-            obs_dir: str | None = None
+            obs_dir: str | None = None,
+            async_sync: bool = True
             ) -> tuple[int, str, float, dict | None]:
     env = dict(os.environ, PYTHONPATH=REPO)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("WH_FAULT_SPEC", None)
     env.pop("WH_OBS_DIR", None)
+    # the matrix exercises recovery at the PRODUCTION operating point:
+    # async overlapped sync + key caching on (--sync-mode turns it off)
+    env["WH_ASYNC_SYNC"] = "1" if async_sync else "0"
+    env["WH_KEYCACHE"] = "1" if async_sync else "0"
     if spec:
         env["WH_FAULT_SPEC"] = spec
     if obs_dir:
@@ -125,7 +135,8 @@ def final_logloss(out: str) -> float | None:
 
 # run_report.json summary keys the matrix compares across scenarios
 _METRIC_KEYS = ("ps_retries", "journal_replays", "replay_dedup_hits",
-                "server_restores", "server_recoveries", "connect_retries")
+                "server_restores", "server_recoveries", "connect_retries",
+                "keycache_invalidations")
 
 
 def report_metrics(report: dict | None) -> dict[str, int]:
@@ -148,6 +159,10 @@ def main(argv=None) -> int:
     ap.add_argument("--servers", type=int, default=2)
     ap.add_argument("--restarts", type=int, default=1,
                     help="--max-server-restarts for the faulted runs")
+    ap.add_argument("--sync-mode", action="store_true",
+                    help="run with WH_ASYNC_SYNC=0 WH_KEYCACHE=0 (the "
+                         "pre-overlap synchronous plane); default is "
+                         "async + key caching on")
     ap.add_argument("--no-recovery", action="store_true",
                     help="run the matrix with recovery OFF: every "
                          "server-kill scenario should then FAIL fast "
@@ -191,7 +206,8 @@ max_delay = 1
 
     rc, out, dt, base_report = run_job(
         conf, "", args.workers, args.servers, restarts, args.timeout,
-        obs_dir=os.path.join(scratch, "obs-baseline"))
+        obs_dir=os.path.join(scratch, "obs-baseline"),
+        async_sync=not args.sync_mode)
     base = final_logloss(out)
     if rc != 0 or base is None:
         print(out[-4000:])
@@ -210,7 +226,8 @@ max_delay = 1
     for i, spec in enumerate(args.specs):
         rc, out, dt, report = run_job(
             conf, spec, args.workers, args.servers, restarts,
-            args.timeout, obs_dir=os.path.join(scratch, f"obs-{i}"))
+            args.timeout, obs_dir=os.path.join(scratch, f"obs-{i}"),
+            async_sync=not args.sync_mode)
         ll = final_logloss(out)
         m = report_metrics(report)
         undeduped = m["journal_replays"] - m["replay_dedup_hits"]
@@ -224,13 +241,18 @@ max_delay = 1
             detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
             worst = max(worst, 3)
         elif report is not None and spec.startswith("net:") \
-                and "reset" in spec and undeduped > 0:
-            # no server died, so no journal entry was legitimately
-            # re-applied: a replay the seq fence did NOT dup-ack is a
-            # double-applied gradient, whatever the logloss says
+                and "reset" in spec and undeduped > m["ps_retries"]:
+            # no server died, so every JOURNALED push that replays was
+            # already acked (journaling happens on the reply path) and
+            # must dup-ack on the seq fence. The sole legitimate fresh
+            # apply is the in-flight push whose request the reset cut
+            # mid-delivery — the retry is the server's first sight of
+            # it — and each reconnect carries at most one of those.
+            # Un-deduped replays beyond the reconnect count are
+            # double-applied gradients, whatever the logloss says
             verdict = "SILENT-CORRUPTION"
-            detail = (f"logloss={ll:.5f} but {undeduped} replayed "
-                      f"pushes were NOT dup-acked "
+            detail = (f"logloss={ll:.5f} but {undeduped} un-deduped "
+                      f"replays exceed {m['ps_retries']} reconnects "
                       f"(replays={m['journal_replays']} "
                       f"dedup={m['replay_dedup_hits']})")
             worst = max(worst, 3)
@@ -251,6 +273,15 @@ max_delay = 1
                 # machinery reported doing anything — the survival is
                 # luck (e.g. the server died after its last useful op)
                 verdict = "survived (no recovery observed!)"
+            elif report is not None and "kill" in spec \
+                    and not args.sync_mode \
+                    and m["keycache_invalidations"] < 1:
+                # key caching is on and a server died: SOMETHING must
+                # have dropped its cached key lists (server restore
+                # and/or client reconnect) — a kill recovery that never
+                # invalidates means stale digests could resolve to the
+                # wrong key list after a respawn
+                verdict = "survived (keycache never invalidated!)"
         recov = len(re.findall(r"respawning with restore epoch", out))
         retries = len(re.findall(r"\[ps-retry\]", out))
         deltas = metric_deltas(m, base_m) if report is not None \

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""PS-plane microbench: per-stage ms/sync for the sparse sync path.
+
+Times each stage of one SyncedStore sync in isolation — gather (touched
+device/host rows -> delta arrays), encode (wire serialization of the
+push payload), merge (server-side push apply: key-cache resolve +
+scatter-add + version stamping), pull_read (server-side versioned-pull
+row assembly), pull_apply (client-side scatter of pulled rows), wire
+(everything else in the round-trip: framing, sockets, decode) — then
+the composed loops: sync mode ms/sync, async mode ms/sync as the train
+loop sees it (with simulated compute between syncs) plus the measured
+overlap fraction, and the key-cache wire saving (bytes/sync, first sync
+vs steady state). Extends tools/ps_sync_micro.py, which only had the
+3-way gather/push/pull split; this is where PERF.md "PS plane" numbers
+come from.
+
+CPU-safe: defaults JAX_PLATFORMS=cpu when unset, so it runs anywhere
+the tests run (tests/test_ps_async.py wires it into the slow tier).
+
+Usage: python tools/ps_lab.py [--buckets N] [--nnz N] [--syncs N]
+       [--servers N] [--compute-ms MS] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+class _Store:
+    """Host-numpy stand-in for the learner's KV store; records time
+    spent in scatter_rows so pull-apply cost is attributable."""
+
+    def __init__(self, nb):
+        self.tables = {k: np.zeros(nb, np.float32) for k in ("w", "z", "n")}
+        self.scatter_s = 0.0
+
+    def to_numpy(self):
+        return dict(self.tables)
+
+    def from_numpy(self, arrays):
+        for k, v in arrays.items():
+            self.tables[k] = np.array(v, np.float32)
+
+    def gather_rows(self, k, idx):
+        return self.tables[k][idx]
+
+    def scatter_rows(self, k, idx, vals):
+        t0 = time.perf_counter()
+        self.tables[k][idx] = vals
+        self.scatter_s += time.perf_counter() - t0
+
+    def zero_init_names(self):
+        return set(self.tables)
+
+
+class _OpTimer:
+    """Wraps ServerNode._dispatch to attribute server-side wall per op
+    (the handler runs in-process, so this is real merge/scan time)."""
+
+    def __init__(self, nodes):
+        self.s = {}
+        self._orig = []
+        for n in nodes:
+            orig = n._dispatch
+
+            def timed(header, arrays, _orig=orig):
+                t0 = time.perf_counter()
+                try:
+                    return _orig(header, arrays)
+                finally:
+                    op = header.get("op")
+                    self.s[op] = self.s.get(op, 0.0) \
+                        + time.perf_counter() - t0
+
+            n._dispatch = timed
+            self._orig.append((n, orig))
+
+    def take(self, op):
+        return self.s.pop(op, 0.0)
+
+
+def _mk(nb, nnz, servers, keycache, async_sync, touched):
+    from wormhole_tpu.runtime.ps_server import (PSClient, ServerNode,
+                                                SyncedStore)
+
+    nodes = [ServerNode(r, servers) for r in range(servers)]
+    for n in nodes:
+        n.serve()
+    client = PSClient([n.uri for n in nodes], sender="lab-0",
+                      keycache=keycache)
+    st = _Store(nb)
+    derived = {"w": {"kind": "ftrl_prox", "lr_eta": 0.1, "lr_beta": 1.0,
+                     "lambda_l1": 1.0, "lambda_l2": 0.0}}
+    ss = SyncedStore(st, client, max_delay=1, derived=derived,
+                     async_sync=async_sync,
+                     touched_fn=lambda: {k: touched for k in ("z", "n")})
+    ss.init()
+    return nodes, client, st, ss
+
+
+def _teardown(nodes, client, ss):
+    ss.close()
+    client.close()
+    for n in nodes:
+        n.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--buckets", type=int, default=1 << 22,
+                    help="table rows (bench operating point: 1<<26)")
+    ap.add_argument("--nnz", type=int, default=100_000,
+                    help="zipf draws per sync (bench point: 975000)")
+    ap.add_argument("--syncs", type=int, default=4)
+    ap.add_argument("--servers", type=int, default=1)
+    ap.add_argument("--compute-ms", type=float, default=50.0,
+                    help="simulated device compute between async syncs")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per stage instead of a table")
+    args = ap.parse_args(argv)
+
+    from wormhole_tpu.runtime import net
+
+    rng = np.random.default_rng(0)
+    touched = np.unique(
+        rng.zipf(1.2, size=args.nnz).astype(np.int64) % args.buckets)
+    rows = []
+
+    def emit(stage, ms, **kw):
+        rows.append(dict({"stage": stage, "ms_per_sync": round(ms, 3)},
+                         **kw))
+
+    # ---- per-stage, sync mode, key cache off (the un-overlapped truth)
+    nodes, client, st, ss = _mk(args.buckets, len(touched), args.servers,
+                                keycache=False, async_sync=False,
+                                touched=touched)
+    opt = _OpTimer(nodes)
+    g_s = e_s = push_s = pull_s = 0.0
+    # warmup sync: first push materializes the spec-created tables and
+    # version arrays server-side (a one-time O(table) cost that must not
+    # pollute the steady-state per-stage numbers)
+    st.tables["z"][touched] += 0.1
+    st.tables["n"][touched] += 0.01
+    ss.sync()
+    opt.take("push"), opt.take("pull")  # drop init+warmup ops
+    st.scatter_s = 0.0
+    for _ in range(args.syncs):
+        st.tables["z"][touched] += 0.1
+        st.tables["n"][touched] += 0.01
+        t0 = time.perf_counter()
+        got = ss._touched_groups()
+        t1 = time.perf_counter()
+        g_s += t1 - t0
+        for a in (*got[0].values(), *got[1].values()):
+            net._encode(a)
+        e_s += time.perf_counter() - t1
+        t2 = time.perf_counter()
+        client.push_sparse(*got)
+        t3 = time.perf_counter()
+        ss._apply_pull()
+        push_s += t3 - t2
+        pull_s += time.perf_counter() - t3
+    n = args.syncs
+    merge_s = opt.take("push")
+    pread_s = opt.take("pull")
+    papply_s = st.scatter_s
+    emit("gather", 1e3 * g_s / n)
+    emit("encode", 1e3 * e_s / n)
+    emit("merge", 1e3 * merge_s / n)
+    emit("pull_read", 1e3 * pread_s / n)
+    emit("pull_apply", 1e3 * papply_s / n)
+    # the push encode ran twice (standalone + inside push_sparse): wire
+    # = round-trip minus the attributed server/encode/apply shares
+    wire = (push_s + pull_s) - e_s - merge_s - pread_s - papply_s
+    emit("wire", 1e3 * max(wire, 0.0) / n)
+    emit("sync_total", 1e3 * (g_s + push_s + pull_s) / n,
+         touched_rows=int(len(touched)))
+    _teardown(nodes, client, ss)
+
+    # ---- key-cache wire saving: first sync ships keys, steady state
+    # ships digests + values only
+    nodes, client, st, ss = _mk(args.buckets, len(touched), args.servers,
+                                keycache=True, async_sync=False,
+                                touched=touched)
+    per_sync = []
+    for _ in range(max(args.syncs, 2)):
+        st.tables["z"][touched] += 0.1
+        st.tables["n"][touched] += 0.01
+        b0 = client.bytes_push + client.bytes_pull
+        ss.sync()
+        per_sync.append(client.bytes_push + client.bytes_pull - b0)
+    kc_hit_rate = (client.kc_hits / max(client.kc_hits + client.kc_misses, 1))
+    emit("keycache", 0.0, bytes_first_sync=per_sync[0],
+         bytes_steady_sync=per_sync[-1],
+         saving_frac=round(1.0 - per_sync[-1] / max(per_sync[0], 1), 4),
+         hit_rate=round(kc_hit_rate, 4))
+    _teardown(nodes, client, ss)
+
+    # ---- async overlap timeline: the train loop's view of sync() with
+    # simulated compute in between (sleep stands in for device steps)
+    for mode, async_on in (("sync_loop", False), ("async_loop", True)):
+        nodes, client, st, ss = _mk(args.buckets, len(touched),
+                                    args.servers, keycache=True,
+                                    async_sync=async_on, touched=touched)
+        st.tables["z"][touched] += 0.1
+        ss.sync()
+        ss.flush()  # warmup: table materialization + key-list exchange
+        # the warmup flush waited out its whole round-trip; start the
+        # overlap accounting fresh
+        ss._rt_wall = ss._wait_wall = ss._push_s = ss._pull_s = 0.0
+        ss.num_syncs = 0
+        t_loop = time.perf_counter()
+        sync_wall = 0.0
+        for _ in range(args.syncs):
+            time.sleep(args.compute_ms / 1e3)
+            st.tables["z"][touched] += 0.1
+            st.tables["n"][touched] += 0.01
+            t0 = time.perf_counter()
+            ss.sync()
+            sync_wall += time.perf_counter() - t0
+        ss.flush()
+        wall = time.perf_counter() - t_loop
+        ws = ss.wire_stats()
+        emit(mode, 1e3 * sync_wall / n, wall_ms_total=round(1e3 * wall, 1),
+             overlap_frac=ws["sync_overlap_frac"],
+             keycache_hit_rate=ws["keycache_hit_rate"])
+        _teardown(nodes, client, ss)
+
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+    else:
+        print(f"{'stage':<12} {'ms/sync':>9}   detail")
+        for r in rows:
+            extra = " ".join(f"{k}={v}" for k, v in r.items()
+                             if k not in ("stage", "ms_per_sync"))
+            print(f"{r['stage']:<12} {r['ms_per_sync']:>9.3f}   {extra}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

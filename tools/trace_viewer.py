@@ -63,12 +63,15 @@ def merge_traces(paths: list[str]) -> dict:
     if not loaded:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
 
-    # wall time of a record: anchor.wall + (ts - anchor.mono)
-    t0 = min(
-        a["wall"] + (min((r["ts"] for r in recs), default=a["mono"])
-                     - a["mono"])
-        for a, recs in loaded
-    )
+    # wall time of a record: anchor.wall + (ts - anchor.mono). Each
+    # record's wall time is materialized BEFORE taking the min so the
+    # earliest event subtracts its own float exactly to 0 — folding the
+    # anchor into a per-file offset instead leaves ~ulp(wall) ≈ 0.5 us
+    # of rounding noise, enough to push early events' ts negative
+    walls = {id(recs): [a["wall"] + (r["ts"] - a["mono"]) for r in recs]
+             for a, recs in loaded}
+    t0 = min((w for ws in walls.values() for w in ws),
+             default=loaded[0][0]["wall"])
     events = []
     run_ids = set()
     for pid_num, (anchor, records) in enumerate(
@@ -81,15 +84,14 @@ def merge_traces(paths: list[str]) -> dict:
         events.append({"ph": "M", "name": "process_sort_index",
                        "pid": pid_num, "tid": 0,
                        "args": {"sort_index": pid_num}})
-        off = anchor["wall"] - anchor["mono"] - t0  # mono s -> rel wall s
-        for r in records:
+        for r, rw in zip(records, walls[id(records)]):
             ev = {
                 "ph": r.get("ph", "X"),
                 "name": r.get("name", "?"),
                 "cat": r.get("cat", "span"),
                 "pid": pid_num,
                 "tid": r.get("tid", 0),
-                "ts": (r["ts"] + off) * 1e6,  # Chrome wants microseconds
+                "ts": (rw - t0) * 1e6,  # Chrome wants microseconds
             }
             if ev["ph"] == "X":
                 ev["dur"] = r.get("dur", 0.0) * 1e6

@@ -604,8 +604,10 @@ def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
     solver = MinibatchSolver(learner, cfg, verbose=False)
     if synced is not None:
         synced.perf = solver.perf
+        solver.sync_flush = synced.flush
     result = {}
     last_train = None  # (nex, seconds) of the last train round (warm)
+    last_round_wire = 0.0  # wire bytes/sync of that round alone
     while (rnd := pool.sync_round()) is not None:
         wtype = WorkType(rnd["type"])
         if synced is not None:
@@ -620,11 +622,25 @@ def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
                 # avoids N-fold overcounting)
                 client.report({"new_w": float(learner.nnz())})
         t_rnd = time.perf_counter()
+        if synced is not None and wtype == WorkType.TRAIN:
+            rnd_b0 = synced.client.bytes_push + synced.client.bytes_pull
+            rnd_s0 = synced.num_syncs
         prog = _drain_round(solver, learner, pool, wtype, rnd["data_pass"],
                             synced)
         if wtype == WorkType.TRAIN:
             last_train = (prog.value("nex"), time.perf_counter() - t_rnd)
+            if synced is not None:
+                # last TRAIN round's wire volume in isolation: epoch 2+
+                # is where the key cache ships digest-only frames, and
+                # a whole-run average would hide that behind epoch 1's
+                # full key sends (the bench's >=25% saving check)
+                db = (synced.client.bytes_push + synced.client.bytes_pull
+                      - rnd_b0)
+                ds = max(synced.num_syncs - rnd_s0, 1)
+                last_round_wire = db / ds
         result["train" if wtype == WorkType.TRAIN else "val"] = prog
+    if synced is not None:
+        synced.close()  # drain + stop the async comms thread
     if synced is not None and last_train is not None:
         # machine-readable wire accounting (the sparse-PS bench parses
         # this; wire bytes/sync is the measured sparse-wire claim)
@@ -632,7 +648,8 @@ def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
 
         stats = dict(synced.wire_stats(), rank=env.rank,
                      last_round_nex=last_train[0],
-                     last_round_sec=round(last_train[1], 3))
+                     last_round_sec=round(last_train[1], 3),
+                     last_round_bytes_per_sync=round(last_round_wire, 1))
         if synced.perf is not None:
             # per-class wall sums so the PS bench can attribute the
             # dist-vs-single gap (push wire+merge / pull / loader wait /
@@ -693,7 +710,10 @@ def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass,
                 if train and synced is not None:
                     synced.maybe_sync()
             if train and synced is not None:
-                synced.sync()
+                # barrier, not plain sync: with async sync on there may
+                # be a round-trip still in flight — the finish RPC's
+                # contract is "every contribution already merged"
+                synced.flush()
         prog.merge(part_prog)
         pool.finish(part_id, part_prog)
     return prog

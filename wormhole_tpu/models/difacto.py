@@ -139,6 +139,16 @@ class _CombinedStore:
     def gather_rows(self, name, idx):
         return self._sub(name).gather_rows(name, idx)
 
+    def gather_rows_multi(self, names, idx):
+        by_store = {}
+        for k in names:
+            by_store.setdefault(id(self._sub(k)), (self._sub(k), []))[1] \
+                .append(k)
+        out = {}
+        for s, ks in by_store.values():
+            out.update(s.gather_rows_multi(ks, idx))
+        return out
+
     def scatter_rows(self, name, idx, vals):
         self._sub(name).scatter_rows(name, idx, vals)
 

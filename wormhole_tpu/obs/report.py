@@ -69,6 +69,9 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "server_recoveries": c.get("sched.server_recoveries", 0),
         "server_restores": c.get("ps.server.restores", 0),
         "liveness_evictions": c.get("sched.liveness_evictions", 0),
+        "keycache_hits": c.get("ps.keycache.hits", 0),
+        "keycache_misses": c.get("ps.keycache.misses", 0),
+        "keycache_invalidations": c.get("ps.keycache.invalidations", 0),
     }
     report = {
         "run_id": run_id or os.environ.get("WH_RUN_ID"),
@@ -141,6 +144,12 @@ def format_lines(report: dict) -> list[str]:
         f"server_recoveries={s['server_recoveries']} "
         f"restores={s['server_restores']} "
         f"evictions={s['liveness_evictions']}")
+    if s.get("keycache_hits") or s.get("keycache_misses") \
+            or s.get("keycache_invalidations"):
+        lines.append(
+            f"  keycache: hits={s['keycache_hits']} "
+            f"misses={s['keycache_misses']} "
+            f"invalidations={s['keycache_invalidations']}")
     return lines
 
 

@@ -15,7 +15,14 @@ header length | JSON header | raw payload. header = {"op": str, ...meta,
 buffers concatenated in array order. Integer arrays (sparse-push/pull
 row indices) ride the same frame with enc="i32"/"i64"; "comp": "zlib"
 marks a compressed buffer ("nbytes" is then the compressed size,
-"rawbytes" the original).
+"rawbytes" the original). Key-list caching (the reference's KEY_CACHING
+filter) rides the JSON header as `key_digest()` fingerprints — a frame
+whose digest the receiver has cached omits the index array entirely
+(runtime/ps_server.py owns the cache + miss/full-resend protocol).
+
+Decoded arrays are zero-copy views over the received buffer and may be
+READ-ONLY (raw/i32/i64 encodings); callers that mutate a decoded array
+in place must copy it first.
 
 Fault injection (runtime/faults.py) hooks frame send/recv; the guards
 are module-level None checks so an unfaulted process pays nothing.
@@ -26,6 +33,7 @@ via handles cached at import.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import socket
 import struct
@@ -58,7 +66,13 @@ def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
     backoff = 0.05
     while True:
         try:
-            return socket.create_connection(addr, timeout=timeout)
+            sock = socket.create_connection(addr, timeout=timeout)
+            # request/response framing on a Nagle'd socket interacts
+            # with delayed ACK: the tail segment of every frame can sit
+            # ~40ms waiting for the peer's ACK, which dwarfs the actual
+            # PS sync work (tools/ps_lab.py measures the difference)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
         except OSError:
             _CONNECT_RETRIES.inc()
             if time.monotonic() >= deadline:
@@ -104,20 +118,30 @@ def _encode(a: np.ndarray, fixed_bytes: int = 0,
     return meta, buf
 
 
+def key_digest(idx: np.ndarray) -> str:
+    """Content fingerprint of a key (row-index) vector, the unit of the
+    KEY_CACHING filter: two frames whose sorted-unique index arrays hash
+    equal carry the same key list, so the second can ship digest-only.
+    blake2b like the pack cache's fingerprints — fast and collision-safe
+    at 12 bytes for the per-sender cache sizes involved."""
+    a = np.ascontiguousarray(idx, np.int64)
+    return hashlib.blake2b(a.tobytes(), digest_size=12).hexdigest()
+
+
 def _decode(meta: dict, buf: bytes) -> np.ndarray:
     shape = tuple(meta["shape"])
     enc = meta["enc"]
     if meta.get("comp") == "zlib":
         buf = zlib.decompress(buf)
     if enc == "raw":
-        return np.frombuffer(buf, np.float32).reshape(shape).copy()
+        return np.frombuffer(buf, np.float32).reshape(shape)
     if enc == "i32":
-        return np.frombuffer(buf, np.int32).reshape(shape).copy()
+        return np.frombuffer(buf, np.int32).reshape(shape)
     if enc == "i64":
-        return np.frombuffer(buf, np.int64).reshape(shape).copy()
+        return np.frombuffer(buf, np.int64).reshape(shape)
     if enc == "bf16":
         u = np.frombuffer(buf, np.uint16).astype(np.uint32) << 16
-        return u.view(np.float32).reshape(shape).copy()
+        return u.view(np.float32).reshape(shape)
     if enc == "int8":
         q = np.frombuffer(buf, np.int8).astype(np.float32)
         return (q * meta["scale"]).reshape(shape)

@@ -32,9 +32,26 @@ This module is the TPU build's cross-process equivalent:
   bits, 1 = int8 + scale — the FIXING_FLOAT/TRUNCATE filter parity,
   async_sgd.h:290-301) and optionally zlib-compressed (the
   msg_compression filter, linear config.proto:123-133). The reference's
-  third filter, KEY_CACHING, avoids resending identical key lists; the
-  sparse wire sends each sync's touched-index set exactly once per
-  table-group already, so there is no repeated key list to cache.
+  third filter, KEY_CACHING, avoids resending identical key lists;
+  `WH_KEYCACHE=1` enables its analog here: frames carry a blake2b
+  digest of each group's sorted key vector, servers cache key lists per
+  (sender, digest), and a repeated touched set (the common case on
+  epoch 2+ under the pack cache) ships digest + values only, with a
+  miss-reply -> full-resend fallback. Caches are invalidated by the
+  recovery path (server restore/reload, client reconnect), counted in
+  `ps.keycache.{hits,misses,invalidations}`.
+- **Async sync** (`WH_ASYNC_SYNC=1`): `SyncedStore.sync()` snapshots the
+  touched rows + deltas and hands the push+pull round-trip to a
+  background comms thread (ps-lite's ZPush/ZPull-return-immediately
+  semantics), folding the pull result in at the NEXT sync boundary —
+  device compute overlaps the wire, and effective staleness grows to at
+  most 2*max_delay minibatches. `flush()` is the barrier (part ends,
+  eval, checkpoints): it drains the in-flight round-trip and runs one
+  synchronous sync so results stay well-defined. With the knob off the
+  sync path is bit-identical to the original synchronous one.
+- Multi-server pushes/pulls fan their per-server slices out on a small
+  thread pool (one socket per server), so a sync against `-s` servers
+  costs max-of-shards, not sum-of-shards.
 
 Server discovery rides the scheduler control plane: servers register
 their URI (op=register_server), workers poll op=servers until all `-s`
@@ -44,8 +61,10 @@ URIs are known.
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import json
 import os
+import queue
 import time
 import socket
 import socketserver
@@ -59,9 +78,9 @@ from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime.net import (  # noqa: F401  (re-exported: the wire
     _COMPRESS_MIN, _decode, _encode, _read_exact, connect_with_retry,
-    recv_frame, send_frame)  # format moved to net.py so fault injection can
-# hook frame send/recv for every net user; tests and tools keep importing
-# the names from here.
+    key_digest, recv_frame, send_frame)  # format moved to net.py so fault
+# injection can hook frame send/recv for every net user; tests and tools
+# keep importing the names from here.
 
 # registry handles cached at import (see wormhole_tpu/obs/metrics.py)
 _NUM_PUSH = _obs.REGISTRY.counter("ps.server.num_push")
@@ -81,6 +100,24 @@ _ROLLBACKS = _obs.REGISTRY.counter("ps.client.rollback_repulls")
 _SYNCS = _obs.REGISTRY.counter("ps.client.syncs")
 _SYNC_PUSH_S = _obs.REGISTRY.histogram("ps.client.sync_push_s")
 _SYNC_PULL_S = _obs.REGISTRY.histogram("ps.client.sync_pull_s")
+# async-sync plane: in-flight round-trips (0 or 1 per SyncedStore),
+# fraction of round-trip wall hidden behind device compute, and the
+# fold-wait the training loop actually paid at sync boundaries
+_SYNC_INFLIGHT = _obs.REGISTRY.gauge("ps.sync.inflight")
+_SYNC_OVERLAP = _obs.REGISTRY.gauge("ps.sync.overlap_frac")
+_SYNC_WAIT_S = _obs.REGISTRY.histogram("ps.client.sync_wait_s")
+# key-list caching (the KEY_CACHING filter analog): hits = frames that
+# shipped digest-only, misses = digest sends the receiver couldn't
+# resolve (followed by a full resend), invalidations = cache discards
+# on the recovery path (server restore/reload, client reconnect)
+_KC_HITS = _obs.REGISTRY.counter("ps.keycache.hits")
+_KC_MISSES = _obs.REGISTRY.counter("ps.keycache.misses")
+_KC_INVALIDATIONS = _obs.REGISTRY.counter("ps.keycache.invalidations")
+
+
+def _env_flag(name: str) -> bool:
+    v = os.environ.get(name)
+    return v is not None and v.lower() not in ("", "0", "false", "off")
 
 # init_spec claim TTL: how long a server waits for a claimant's
 # init_arrays before handing the claim to the next poller. Clients wait
@@ -106,6 +143,10 @@ def _idx_name(rows: int) -> str:
 # ---------------------------------------------------------------- server
 class _PSHandler(socketserver.StreamRequestHandler):
     def handle(self):
+        # mirror the client side's TCP_NODELAY (net.connect_with_retry):
+        # reply frames must not sit out a delayed-ACK window
+        self.connection.setsockopt(socket.IPPROTO_TCP,
+                                   socket.TCP_NODELAY, 1)
         node = self.server.node  # type: ignore
         with node._conns_lock:
             node._conns.add(self.connection)
@@ -207,6 +248,17 @@ class ServerNode:
         # seq fence: last applied push sequence number per sender, the
         # dedup table that makes client-side replay idempotent
         self._last_seq: dict[str, int] = {}
+        # KEY_CACHING filter state (client-driven, see PSClient):
+        # per-sender LRU of key lists received in full (digest ->
+        # shard-local idx) so repeated pushes can ship digest-only, and
+        # per-sender LRU of digests the sender itself is known to hold
+        # (adopted from its full pushes / our full pull replies) so pull
+        # replies can go digest-only too. The known-cap is smaller than
+        # the client's cache, so an omitted reply is nearly always
+        # reconstructible; the client's full-re-pull fallback keeps a
+        # stale assumption harmless.
+        self._kc_idx: dict[str, collections.OrderedDict] = {}
+        self._kc_known: dict[str, collections.OrderedDict] = {}
         # async snapshot state: base path, cadence, clock of the last
         # written snapshot (skip when nothing changed), writer thread
         self._snap_base: Optional[str] = None
@@ -426,6 +478,10 @@ class ServerNode:
                         out[k] = self.tables[k][:0]
                     return {"ok": True, "clock": self.clock}, out
                 self._recompute_derived()
+                sender = header.get("sender")
+                use_kc = bool(header.get("kc")) and sender is not None
+                kdig_hit: dict[str, str] = {}
+                kdig_full: dict[str, str] = {}
                 for g, ver in self._ver.items():
                     if since >= self._log_start.get(g, self.clock):
                         parts = [i for c, i in self._pushlog[g]
@@ -434,11 +490,25 @@ class ServerNode:
                                if parts else np.empty(0, np.int64))
                     else:
                         idx = np.flatnonzero(ver > since).astype(np.int64)
-                    out[_idx_name(g)] = idx
+                    omit = False
+                    if use_kc and idx.size:
+                        dig, held = self._kc_pull_digest(sender, idx)
+                        if held:
+                            kdig_hit[str(g)] = dig
+                            omit = True
+                        else:
+                            kdig_full[str(g)] = dig
+                    if not omit:
+                        out[_idx_name(g)] = idx
                     for k, rows in self.full_rows.items():
                         if rows == g:
                             out[k] = self.tables[k][idx]
-                return {"ok": True, "clock": self.clock}, out
+                resp = {"ok": True, "clock": self.clock}
+                if kdig_hit:
+                    resp["kdig"] = kdig_hit
+                if kdig_full:
+                    resp["kfull"] = kdig_full
+                return resp, out
         if op == "push":
             with self._lock:
                 # seq fence BEFORE the clock advance: a replayed push
@@ -452,6 +522,20 @@ class ServerNode:
                         _DEDUP_HITS.inc()
                         return ({"ok": True, "clock": self.clock,
                                  "dup": True}, {})
+                idx_of = {g: arrays[_idx_name(g)]
+                          for g in self._ver if _idx_name(g) in arrays}
+                # resolve key-list digests BEFORE the fence advances: a
+                # miss reply must leave fence and clock untouched so the
+                # client's full resend (a fresh seq) is a clean first
+                # send, not a dup
+                kdig = header.get("kdig") or {}
+                if kdig and sender is not None:
+                    need = self._kc_resolve(sender, kdig, idx_of)
+                    if need:
+                        _KC_MISSES.inc(len(need))
+                        return ({"ok": True, "clock": self.clock,
+                                 "need_keys": need}, {})
+                if sender is not None and seq is not None:
                     self._last_seq[sender] = int(seq)
                 self.num_push += 1
                 _NUM_PUSH.inc()
@@ -465,8 +549,6 @@ class ServerNode:
                 if self.clock >= 2**32 - 1:
                     return {"error":
                             "version clock exhausted (2^32 pushes)"}, {}
-                idx_of = {g: arrays[_idx_name(g)]
-                          for g in self._ver if _idx_name(g) in arrays}
                 dense_groups = set()
                 for k, d in arrays.items():
                     if k.startswith("idx:"):
@@ -562,6 +644,73 @@ class ServerNode:
         self._log_start[g] = self.clock
         self._log_elems[g] = 0
 
+    # key-cache caps: key lists cached per sender (push side) and
+    # digests assumed still client-held (pull side). The known-cap is
+    # deliberately below the client's own LRU cap so digest-only pull
+    # replies are nearly always reconstructible client-side; the
+    # client's full-re-pull fallback covers the rest.
+    _KC_CAP = 32
+    _KC_KNOWN_CAP = 8
+
+    def _kc_resolve(self, sender: str, kdig: dict, idx_of: dict) -> list:
+        """Adopt/resolve a push's key-list digests (lock held): a group
+        whose idx array rode the frame is cached under its digest; a
+        digest-only group is resolved from the cache into `idx_of`.
+        Returns the groups whose digest is unknown (cache miss — the
+        caller replies need_keys without applying anything)."""
+        cache = self._kc_idx.setdefault(sender, collections.OrderedDict())
+        known = self._kc_known.setdefault(sender, collections.OrderedDict())
+        need = []
+        for gs, dig in kdig.items():
+            g = int(gs)
+            if g in idx_of:
+                # full send: adopt the key list, and remember the sender
+                # holds it (it hashed its own idx) so pull replies with
+                # the same key set can go digest-only
+                cache[dig] = np.ascontiguousarray(idx_of[g], np.int64)
+                cache.move_to_end(dig)
+                known[dig] = True
+                known.move_to_end(dig)
+            else:
+                hit = cache.get(dig)
+                if hit is None:
+                    need.append(gs)
+                else:
+                    cache.move_to_end(dig)
+                    idx_of[g] = hit
+                    _KC_HITS.inc()
+        while len(cache) > self._KC_CAP:
+            cache.popitem(last=False)
+        while len(known) > self._KC_KNOWN_CAP:
+            known.popitem(last=False)
+        return need
+
+    def _kc_pull_digest(self, sender: str,
+                        idx: np.ndarray) -> tuple[str, bool]:
+        """Pull-reply half of the key cache (lock held): returns
+        (digest, held) — `held` means the sender provably has this key
+        list, so the reply may omit the idx array; otherwise the reply
+        ships idx + digest so the client caches it for next time."""
+        dig = key_digest(idx)
+        known = self._kc_known.setdefault(sender, collections.OrderedDict())
+        if dig in known:
+            known.move_to_end(dig)
+            _KC_HITS.inc()
+            return dig, True
+        known[dig] = True
+        while len(known) > self._KC_KNOWN_CAP:
+            known.popitem(last=False)
+        return dig, False
+
+    def _kc_invalidate(self) -> None:
+        """Recovery-path cache discard (snapshot restore / checkpoint
+        load): a rolled-back server must not resolve pre-crash digests
+        (lock held)."""
+        if self._kc_idx or self._kc_known:
+            _KC_INVALIDATIONS.inc()
+        self._kc_idx = {}
+        self._kc_known = {}
+
     def _recompute_derived(self) -> None:
         """Recompute derived tables from their additive sources over the
         rows dirtied since the last recompute (caller holds the lock).
@@ -636,6 +785,7 @@ class ServerNode:
             k: [self.full_rows[k], *v.shape[1:]]
             for k, v in shard_arrays.items()}
         self._loaded = True
+        self._kc_invalidate()
         # a pre-load init_spec may have left pending/claim state; the
         # checkpoint supersedes it (a late init_arrays must not
         # overwrite loaded tables)
@@ -643,7 +793,9 @@ class ServerNode:
         self._claims = {}
         self._zero_flags = None
         for k, v in shard_arrays.items():
-            self.tables[k] = np.ascontiguousarray(v, np.float32)
+            # np.array (not ascontiguousarray): decoded wire arrays are
+            # read-only zero-copy views and tables get merged in place
+            self.tables[k] = np.array(v, np.float32)
         self._create_group_meta()
         self.clock = 1
         for g, ver in self._ver.items():
@@ -807,6 +959,7 @@ class ServerNode:
             self._zero_flags = meta["zero_flags"]
             self._pending = set()
             self._claims = {}
+            self._kc_invalidate()
             self._create_group_meta()
             self.clock = int(meta["clock"])
             self._snap_clock = self.clock
@@ -855,10 +1008,14 @@ class PSClient:
     server rolled-back; the next pull_sparse turns into a since=0 re-pull
     so the base mirror re-adopts the restored state."""
 
+    # client-side key-list LRU cap: above the server's _KC_KNOWN_CAP so
+    # a digest-only pull reply is nearly always reconstructible here
+    _KC_CLIENT_CAP = 64
+
     def __init__(self, uris: list[str], connect_deadline: float = 30.0,
                  sender: Optional[str] = None, retry_deadline: float = 0.0,
                  resolver: Optional[Callable[[], Optional[list[str]]]] = None,
-                 journal_len: int = 64):
+                 journal_len: int = 64, keycache: Optional[bool] = None):
         self.uris = list(uris)
         self.world = len(uris)
         self._socks: list[Optional[socket.socket]] = [None] * self.world
@@ -880,6 +1037,26 @@ class PSClient:
         self._epochs: list[Optional[int]] = [None] * self.world
         self._rolled_back = [False] * self.world
         self.num_retries = 0
+        # KEY_CACHING filter, client half (default from WH_KEYCACHE):
+        # per-server LRU of digest -> shard-local idx (content-addressed;
+        # fed by our own full pushes AND full pull replies) plus the
+        # digests each server has ack'd receiving, so repeat pushes ship
+        # digest + values only
+        self.keycache = (_env_flag("WH_KEYCACHE") if keycache is None
+                         else bool(keycache))
+        self._kc_idx = [collections.OrderedDict()
+                        for _ in range(self.world)]
+        self._kc_pushed = [collections.OrderedDict()
+                           for _ in range(self.world)]
+        self.kc_hits = 0
+        self.kc_misses = 0
+        # byte/hit tallies are written from pool threads during fanned
+        # pushes/pulls; a plain int += is a load-add-store race
+        self._stats_lock = threading.Lock()
+        # per-server RPC fan-out pool, created on first multi-server
+        # push/pull (one socket per server, per-rank client state — the
+        # only shared mutables are behind _stats_lock)
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 
     def _file(self, r: int):
         if self._files[r] is None:
@@ -921,7 +1098,7 @@ class PSClient:
         self._epochs[r] = ep
 
     def _rpc(self, r: int, header: dict, arrays=None, fixed_bytes: int = 0,
-             compress: bool = False):
+             compress: bool = False, journal_arrays=None):
         if compress:
             header = dict(header, comp_reply=1)
         op_name = header.get("op", "?")
@@ -971,16 +1148,26 @@ class PSClient:
         self._note_epoch(r, h)
         op = header.get("op")
         if op == "push":
-            self.bytes_push += sent + received
+            with self._stats_lock:
+                self.bytes_push += sent + received
             _BYTES_PUSH.inc(sent + received)
-            if self.retry_deadline > 0 and self.sender is not None:
+            if (self.retry_deadline > 0 and self.sender is not None
+                    and not h.get("need_keys")):
+                # journal the FULL-keys form (journal_arrays) so a
+                # replay after a reconnect is self-contained even when
+                # the original frame shipped digest-only; a need_keys
+                # miss reply applied nothing, so the full resend (not
+                # this frame) is what gets journaled
                 self._journal[r].append(
-                    (header["seq"], header, arrays, fixed_bytes, compress))
+                    (header["seq"], header, journal_arrays or arrays,
+                     fixed_bytes, compress))
         elif op == "pull":
-            self.bytes_pull += sent + received
+            with self._stats_lock:
+                self.bytes_pull += sent + received
             _BYTES_PULL.inc(sent + received)
         elif op in ("init", "init_spec", "init_arrays"):
-            self.bytes_init += sent + received
+            with self._stats_lock:
+                self.bytes_init += sent + received
         return h, arrs
 
     def _recover(self, r: int, op_name: str, err: Exception) -> None:
@@ -1024,6 +1211,15 @@ class PSClient:
                 _RETRIES.inc()
                 _trace.event("ps.reconnect", cat="recovery", server=r,
                              uri=self.uris[r], epoch=self._epochs[r])
+                if self.keycache and (self._kc_pushed[r]
+                                      or self._kc_idx[r]):
+                    # the peer may be a fresh/restored process whose key
+                    # cache died with the old one: drop both directions
+                    # for this rank (correctness never depended on the
+                    # cache; the next syncs re-prime it)
+                    _KC_INVALIDATIONS.inc()
+                    self._kc_pushed[r].clear()
+                    self._kc_idx[r].clear()
                 applied = int(h.get("last_seq", 0))
                 replay = [e for e in self._journal[r] if e[0] > applied]
                 # the RPC being retried is re-sent by _rpc after we
@@ -1072,6 +1268,34 @@ class PSClient:
                 pass
             self._socks[i] = None
             self._files[i] = None
+        if r is None and self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def _fan(self, fn: Callable[[int], object]) -> list:
+        """Run fn(r) against every server. Multi-server clients fan out
+        on a small thread pool (one socket per server; all per-rank
+        client state is rank-indexed, shared tallies sit behind
+        _stats_lock), so a sync costs max-of-shards instead of
+        sum-of-shards. Results come back in rank order; the first
+        worker exception propagates."""
+        if self.world == 1:
+            return [fn(0)]
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(self.world, 8),
+                thread_name_prefix="ps-rpc")
+        futs = [self._pool.submit(fn, r) for r in range(self.world)]
+        return [f.result() for f in futs]
+
+    def _kc_cache_idx(self, r: int, dig: str, idx: np.ndarray) -> None:
+        """Remember a key list by content digest (per-server LRU) so a
+        later digest-only pull reply can be reconstructed locally."""
+        lru = self._kc_idx[r]
+        lru[dig] = idx
+        lru.move_to_end(dig)
+        while len(lru) > self._KC_CLIENT_CAP:
+            lru.popitem(last=False)
 
     # -- table ops ----------------------------------------------------------
     def _slices(self, tables: dict[str, np.ndarray], r: int):
@@ -1148,10 +1372,9 @@ class PSClient:
         """Versioned pull: rows stamped after `since[r]` on each server.
         Returns (new clocks, {group_rows: global indices},
         {table: rows aligned to its group's indices})."""
-        clocks = []
-        g_idx: dict[int, list] = {}
-        t_rows: dict[str, list] = {}
-        for r in range(self.world):
+        kc = self.keycache and self.sender is not None
+
+        def one(r: int) -> tuple[dict, dict]:
             s = int(since[r])
             if self._rolled_back[r]:
                 # the server restored a snapshot: its clock (and row
@@ -1161,8 +1384,43 @@ class PSClient:
                 # state wholesale.
                 self._rolled_back[r] = False
                 s = 0
-            h, arrs = self._rpc(r, {"op": "pull", "since": s},
-                                compress=compress)
+            header = {"op": "pull", "since": s}
+            if kc:
+                header.update(sender=self.sender, kc=1)
+            h, arrs = self._rpc(r, header, compress=compress)
+            if kc:
+                for gs, dig in (h.get("kfull") or {}).items():
+                    # full reply stamped with its digest: cache the key
+                    # list so the server's next same-set reply can omit
+                    # it
+                    name = _idx_name(int(gs))
+                    if name in arrs:
+                        self._kc_cache_idx(r, dig, arrs[name])
+                kdig = h.get("kdig") or {}
+                missing = any(dig not in self._kc_idx[r]
+                              for dig in kdig.values())
+                if missing:
+                    # digest-only reply we can no longer reconstruct
+                    # (our LRU evicted it): re-pull this server in full
+                    # — correctness never depends on the cache
+                    with self._stats_lock:
+                        self.kc_misses += 1
+                    h, arrs = self._rpc(r, {"op": "pull", "since": s},
+                                        compress=compress)
+                elif kdig:
+                    for gs, dig in kdig.items():
+                        lru = self._kc_idx[r]
+                        lru.move_to_end(dig)
+                        arrs[_idx_name(int(gs))] = lru[dig]
+                    with self._stats_lock:
+                        self.kc_hits += len(kdig)
+            return h, arrs
+
+        got = self._fan(one)
+        clocks = []
+        g_idx: dict[int, list] = {}
+        t_rows: dict[str, list] = {}
+        for r, (h, arrs) in enumerate(got):
             clocks.append(int(h["clock"]))
             for g in {rows for rows in self.full_rows.values()}:
                 name = _idx_name(g)
@@ -1192,22 +1450,67 @@ class PSClient:
         """Sparse delta push. `groups` maps a row-space (full row count)
         to the sorted-unique GLOBAL row indices touched in it;
         `deltas[k]` holds the delta rows of table k aligned to
-        `groups[full_rows[k]]`."""
-        # per-server, per-group selection computed once and shared by all
-        # tables in the group
-        for r in range(self.world):
-            arrays: dict[str, np.ndarray] = {}
-            sel: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        `groups[full_rows[k]]`.
+
+        Sortedness makes each server's slice a contiguous searchsorted
+        range, so the per-server split is two binary searches and VIEWS
+        of the delta rows — no boolean masks, no row copies. With key
+        caching on, a slice whose digest the server already holds ships
+        digest + values only; a need_keys reply (cache lost — e.g. a
+        respawned server) triggers a full resend under a fresh seq."""
+        kc = self.keycache and self.sender is not None
+
+        def one(r: int) -> None:
+            sel: dict[int, slice] = {}
+            loc_of: dict[int, np.ndarray] = {}
+            kdig: dict[str, str] = {}
             for g, idx in groups.items():
                 lo, hi = shard_range(g, r, self.world)
-                m = (idx >= lo) & (idx < hi)
-                sel[g] = (m, idx[m] - lo)
-                arrays[_idx_name(g)] = sel[g][1]
-            for k, rows in deltas.items():
-                g = self.full_rows[k]
-                arrays[k] = rows[sel[g][0]]
-            self._rpc(r, {"op": "push"}, arrays, fixed_bytes=fixed_bytes,
-                      compress=compress)
+                a, b = np.searchsorted(idx, (lo, hi))
+                sel[g] = slice(a, b)
+                loc_of[g] = idx[a:b] - lo
+                if kc:
+                    kdig[str(g)] = key_digest(loc_of[g])
+            vals = {k: rows[sel[self.full_rows[k]]]
+                    for k, rows in deltas.items()}
+            full = {_idx_name(g): v for g, v in loc_of.items()}
+            full.update(vals)
+            if not kc:
+                self._rpc(r, {"op": "push"}, full,
+                          fixed_bytes=fixed_bytes, compress=compress)
+                return
+            header = {"op": "push", "kdig": kdig}
+            send = {_idx_name(g): v for g, v in loc_of.items()
+                    if kdig[str(g)] not in self._kc_pushed[r]}
+            omitted = len(loc_of) - len(send)
+            send.update(vals)
+            h, _ = self._rpc(r, header, send, fixed_bytes=fixed_bytes,
+                             compress=compress, journal_arrays=full)
+            need = h.get("need_keys")
+            if need:
+                # the server lost (or never had) our key lists — a
+                # fresh/restored process. The miss reply advanced
+                # neither fence nor clock, so resend in full; _rpc
+                # stamps a new seq.
+                with self._stats_lock:
+                    self.kc_misses += len(need)
+                self._kc_pushed[r].clear()
+                self._rpc(r, {"op": "push", "kdig": kdig}, full,
+                          fixed_bytes=fixed_bytes, compress=compress)
+            elif omitted:
+                with self._stats_lock:
+                    self.kc_hits += omitted
+            pushed = self._kc_pushed[r]
+            for gs, dig in kdig.items():
+                pushed[dig] = True
+                pushed.move_to_end(dig)
+                # the digest space is content-addressed, so our own
+                # pushed key lists double as pull-reply reconstructions
+                self._kc_cache_idx(r, dig, loc_of[int(gs)])
+            while len(pushed) > ServerNode._KC_CAP:
+                pushed.popitem(last=False)
+
+        self._fan(one)
 
     def save(self, base: str, it: Optional[int] = None) -> list[str]:
         return [self._rpc(r, {"op": "save", "base": base, "iter": it})[0]
@@ -1245,12 +1548,29 @@ class SyncedStore:
     device rows, pushes (indices, deltas), and scatters back the rows
     the versioned pull reports dirty. Without hints it falls back to a
     full-table delta scan (host O(table), wire still sparse: only rows
-    with nonzero delta are sent)."""
+    with nonzero delta are sent).
+
+    Async sync (`async_sync=True`, default from `WH_ASYNC_SYNC`):
+    `sync()` snapshots the touched rows + deltas, advances the base
+    mirror by them ("deltas on the wire ARE part of base"), hands the
+    push+pull round-trip to a daemon comms thread, and returns — the
+    device trains through the round-trip. At most ONE round-trip is in
+    flight; the next sync waits for it and FOLDS the pull in first:
+    for every pulled row, store <- pulled + (cur - base) keeps local
+    un-pushed progress on top of the adopted merged state (derived
+    tables are overwritten — they are not additive), base <- pulled.
+    Effective staleness is therefore at most 2*max_delay minibatches.
+    `flush()` is the barrier for part ends / eval / checkpoints: drain
+    the in-flight round-trip, then one synchronous sync. Recovery
+    composes unchanged: the comms thread rides PSClient's fenced retry,
+    journal replay, and rollback re-pull. With async off, sync() is the
+    original, bit-identical synchronous path."""
 
     def __init__(self, store, client: PSClient, max_delay: int = 16,
                  fixed_bytes: int = 0, derived: Optional[dict] = None,
                  perf=None, touched_fn: Optional[Callable] = None,
-                 compress: bool = False, offer_arrays: bool = False):
+                 compress: bool = False, offer_arrays: bool = False,
+                 async_sync: Optional[bool] = None):
         self.store = store
         self.client = client
         self.perf = perf  # optional utils.perf.Perf: times push/pull ops
@@ -1272,6 +1592,20 @@ class SyncedStore:
         self._clocks: Optional[list[int]] = None
         self._steps = 0
         self.num_syncs = 0
+        self.async_sync = (_env_flag("WH_ASYNC_SYNC") if async_sync is None
+                           else bool(async_sync))
+        # async comms state: at most one in-flight round-trip job (that
+        # bound IS the staleness guarantee) on a lazily started daemon
+        # thread; device-row gathers/scatters stay on the training
+        # thread (jax dispatch), only wire work moves off it
+        self._inflight: Optional[dict] = None
+        self._comm_q: Optional[queue.Queue] = None
+        self._comm_thread: Optional[threading.Thread] = None
+        self._rt_wall = 0.0    # round-trip wall summed (comms thread)
+        self._wait_wall = 0.0  # fold wait actually paid (train thread)
+        self._push_s = 0.0
+        self._pull_s = 0.0
+        self.max_fold_lag = 0  # observed staleness, in sync rounds
 
     def init(self) -> None:
         """Offer this worker's (deterministic) init state, then adopt the
@@ -1326,10 +1660,17 @@ class SyncedStore:
         self._clocks = clocks
 
     def pull(self) -> None:
+        if self.async_sync:
+            # adopt any completed (or still-flying) round-trip before a
+            # fresh pull overwrites rows — base must stay coherent
+            self._fold_pending(wait=True)
         if self._clocks is None:
             pulled = self.client.pull()
             self.store.from_numpy(pulled)
-            self._base = pulled
+            # decoded arrays can be read-only zero-copy views (net.py);
+            # the base mirror gets written by later sparse pulls
+            self._base = {k: np.array(v, np.float32)
+                          for k, v in pulled.items()}
             return
         self._apply_pull()
 
@@ -1341,33 +1682,56 @@ class SyncedStore:
         touched = self.touched_fn()
         if touched is None:
             return None
-        groups: dict[int, np.ndarray] = {}
-        deltas: dict[str, np.ndarray] = {}
+        per_g: dict[int, list[np.ndarray]] = {}
         for k, rows in self.client.full_rows.items():
             if k in self.derived:
                 continue
             idx = touched.get(k)
             if idx is None:
                 return None  # incomplete hint: fall back to the scan
-            g = groups.setdefault(rows, idx)
-            if g is not idx and not np.array_equal(g, idx):
-                g = np.union1d(g, idx)
-                groups[rows] = g
+            per_g.setdefault(rows, []).append(idx)
+        groups = self._union_groups(per_g)
         snap = None if self._sparse_store else self.store.to_numpy()
+        deltas: dict[str, np.ndarray] = {}
+        multi = (getattr(self.store, "gather_rows_multi", None)
+                 if snap is None else None)
+        by_g: dict[int, list[str]] = {}
         for k, rows in self.client.full_rows.items():
-            if k in self.derived:
-                continue
+            if k not in self.derived:
+                by_g.setdefault(rows, []).append(k)
+        for rows, names in by_g.items():
             idx = groups[rows]
-            cur = (self.store.gather_rows(k, idx) if snap is None
-                   else snap[k][idx])
-            deltas[k] = cur - self._base[k][idx]
+            if multi is not None and len(names) > 1:
+                # one padded index transfer + one device dispatch for
+                # the whole group (z, n, ... share the touched set)
+                cur = multi(names, idx)
+            else:
+                cur = {k: (self.store.gather_rows(k, idx) if snap is None
+                           else snap[k][idx]) for k in names}
+            for k in names:
+                deltas[k] = cur[k] - self._base[k][idx]
         return groups, deltas
+
+    @staticmethod
+    def _union_groups(per_g: dict[int, list]) -> dict[int, np.ndarray]:
+        """Union the per-table touched sets of each row-space group with
+        ONE concatenate+unique (repeated pairwise np.union1d re-sorts
+        the whole accumulated set per table: O(k * n log n))."""
+        groups: dict[int, np.ndarray] = {}
+        for rows, parts in per_g.items():
+            first = parts[0]
+            if all(p is first or np.array_equal(p, first)
+                   for p in parts[1:]):
+                groups[rows] = first
+            else:
+                groups[rows] = np.unique(np.concatenate(parts))
+        return groups
 
     def _scan_groups(self):
         """Fallback: full-table delta scan; wire stays sparse (only rows
         whose delta is nonzero ship)."""
         cur = self.store.to_numpy()
-        groups: dict[int, np.ndarray] = {}
+        per_g: dict[int, list[np.ndarray]] = {}
         diffs: dict[str, np.ndarray] = {}
         for k, v in cur.items():
             if k in self.derived:
@@ -1378,14 +1742,162 @@ class SyncedStore:
                 nz = nz.any(axis=tuple(range(1, nz.ndim)))
             idx = np.flatnonzero(nz)
             diffs[k] = d
-            rows = self.client.full_rows[k]
-            g = groups.get(rows)
-            groups[rows] = idx if g is None else np.union1d(g, idx)
+            per_g.setdefault(self.client.full_rows[k], []).append(idx)
+        groups = self._union_groups(per_g)
         deltas = {k: diffs[k][groups[self.client.full_rows[k]]]
                   for k in diffs}
         return groups, deltas
 
+    # -- async comms plane ---------------------------------------------------
+    def _ensure_comm_thread(self) -> None:
+        if self._comm_thread is None:
+            self._comm_q = queue.Queue()
+            self._comm_thread = threading.Thread(
+                target=self._comm_loop, daemon=True, name="ps-sync-comms")
+            self._comm_thread.start()
+
+    def _comm_loop(self) -> None:
+        """Comms thread: run each queued round-trip (push then versioned
+        pull) against the servers. PSClient is touched ONLY from this
+        thread while async mode is live, so the fenced retry / journal
+        replay / rollback machinery runs here unchanged."""
+        while True:
+            job = self._comm_q.get()
+            if job is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                with _trace.span("ps.sync.push", cat="ps"):
+                    self.client.push_sparse(
+                        job["groups"], job["deltas"],
+                        fixed_bytes=self.fixed_bytes,
+                        compress=self.compress)
+                t1 = time.perf_counter()
+                with _trace.span("ps.sync.pull", cat="ps"):
+                    job["pull"] = self.client.pull_sparse(
+                        self._clocks, compress=self.compress)
+                t2 = time.perf_counter()
+                _SYNC_PUSH_S.observe(t1 - t0)
+                _SYNC_PULL_S.observe(t2 - t1)
+                self._push_s += t1 - t0
+                self._pull_s += t2 - t1
+                if self.perf is not None:
+                    self.perf.add("ps_push", t1 - t0)
+                    self.perf.add("ps_pull", t2 - t1)
+            except BaseException as e:  # surfaced at the next fold
+                job["error"] = e
+            finally:
+                job["rt"] = time.perf_counter() - t0
+                job["done"].set()
+
+    def _fold_pending(self, wait: bool) -> None:
+        """Adopt the in-flight round-trip's pull, if any (and, with
+        `wait`, block until it lands). Comms-thread errors re-raise
+        here, on the training thread."""
+        job = self._inflight
+        if job is None:
+            return
+        t0 = time.perf_counter()
+        if wait:
+            job["done"].wait()
+        elif not job["done"].is_set():
+            return
+        waited = time.perf_counter() - t0
+        self._inflight = None
+        _SYNC_INFLIGHT.set(0)
+        err = job.get("error")
+        if err is not None:
+            raise err
+        self._wait_wall += waited
+        self._rt_wall += job["rt"]
+        _SYNC_WAIT_S.observe(waited)
+        if self._rt_wall > 0:
+            _SYNC_OVERLAP.set(
+                max(0.0, 1.0 - self._wait_wall / self._rt_wall))
+        self.max_fold_lag = max(self.max_fold_lag,
+                                self.num_syncs - job["enq_sync"])
+        clocks, groups, tables = job["pull"]
+        self._fold_rows(groups, tables)
+        self._clocks = clocks
+
+    def _fold_rows(self, groups: dict, tables: dict) -> None:
+        """Fold a pull that raced local training: by the time the
+        round-trip landed, the store holds deltas newer than the pushed
+        snapshot. For every pulled row of an additive table,
+
+            store <- pulled + (cur - base);  base <- pulled
+
+        keeps that un-pushed local progress on top of the adopted merged
+        state (base is always "adopted server state + deltas already on
+        the wire", so cur - base IS the un-pushed part). Derived tables
+        (non-additive, e.g. FTRL's w) are overwritten like the sync
+        path; their rows re-cohere the next time they are trained or
+        pulled — the same bounded-staleness wobble async-SGD already
+        accepts."""
+        snap = None
+        if not self._sparse_store:
+            # to_numpy may hand out read-only device views; the fold
+            # mutates rows in place
+            snap = {k: np.array(v, np.float32)
+                    for k, v in self.store.to_numpy().items()}
+        scattered: dict[str, tuple] = {}
+        for k, rows in tables.items():
+            idx = groups[self.client.full_rows[k]]
+            if idx.size == 0:
+                continue
+            if k in self.derived:
+                new = rows
+            else:
+                cur = (self.store.gather_rows(k, idx) if snap is None
+                       else snap[k][idx])
+                new = rows + (cur - self._base[k][idx])
+            self._base[k][idx] = rows
+            if self._sparse_store:
+                self.store.scatter_rows(k, idx, new)
+                scattered[k] = (idx, new)
+            else:
+                snap[k][idx] = new
+        if not self._sparse_store and groups:
+            self.store.from_numpy(snap)
+        elif scattered:
+            # host-mirror coherence hook (see _apply_pull): hand over
+            # the FOLDED rows — they are what the device store now holds
+            hook = getattr(self.store, "on_sparse_pull", None)
+            if hook is not None:
+                hook(scattered)
+
     def sync(self) -> None:
+        if not self.async_sync:
+            self._sync_now()
+            return
+        # adopt the previous round-trip first (waiting if it is still in
+        # flight — one-in-flight is the staleness bound), then snapshot
+        # deltas and hand the next round-trip to the comms thread
+        self._fold_pending(wait=True)
+        with _trace.span("ps.sync.snapshot", cat="ps"):
+            got = self._touched_groups()
+            if got is None:
+                got = self._scan_groups()
+            groups, deltas = got
+            # mark the snapshot as pushed NOW: the next delta starts
+            # from zero and the fold can tell un-pushed progress apart
+            for k, d in deltas.items():
+                idx = groups[self.client.full_rows[k]]
+                if idx.size:
+                    self._base[k][idx] += d
+        self._ensure_comm_thread()
+        job = {"groups": groups, "deltas": deltas,
+               "done": threading.Event(), "enq_sync": self.num_syncs}
+        self._inflight = job
+        _SYNC_INFLIGHT.set(1)
+        self._comm_q.put(job)
+        _SYNCS.inc()
+        self._steps = 0
+        self.num_syncs += 1
+
+    def _sync_now(self) -> None:
+        """The original synchronous round-trip (also the async mode's
+        barrier step): push deltas, then pull+apply the merged rows."""
         t0 = time.perf_counter()
         with _trace.span("ps.sync.push", cat="ps"):
             got = self._touched_groups()
@@ -1402,11 +1914,37 @@ class SyncedStore:
         _SYNC_PUSH_S.observe(t1 - t0)
         _SYNC_PULL_S.observe(t2 - t1)
         _SYNCS.inc()
+        self._push_s += t1 - t0
+        self._pull_s += t2 - t1
         if self.perf is not None:
             self.perf.add("ps_push", t1 - t0)
             self.perf.add("ps_pull", t2 - t1)
         self._steps = 0
         self.num_syncs += 1
+
+    def flush(self) -> None:
+        """Barrier for part ends, eval, and checkpoints: drain the
+        in-flight round-trip, then run one synchronous sync — afterwards
+        every local delta is merged on the servers and the local store
+        holds the freshest merged state (with async off this IS
+        sync()). When no minibatch ran since the last sync there is
+        nothing to push (an adopted in-flight pull already refreshed the
+        mirror), so back-to-back barriers — part end, then pass
+        boundary, then checkpoint — cost one round-trip, not three."""
+        if self.async_sync:
+            self._fold_pending(wait=True)
+        if self._steps == 0 and self.num_syncs > 0:
+            return
+        self._sync_now()
+
+    def close(self) -> None:
+        """Stop the comms thread (tests and orderly teardown; it is a
+        daemon thread otherwise). Pending work is folded first."""
+        if self._comm_thread is not None:
+            self._fold_pending(wait=True)
+            self._comm_q.put(None)
+            self._comm_thread.join(timeout=10)
+            self._comm_thread = None
 
     def maybe_sync(self) -> bool:
         self._steps += 1
@@ -1416,11 +1954,24 @@ class SyncedStore:
         return False
 
     def wire_stats(self) -> dict:
-        """Measured wire traffic (both directions), for the distributed
-        bench's bytes-per-sync line."""
+        """Measured wire traffic (both directions) plus the async/key-
+        cache operating point, for the distributed bench's [ps-wire]
+        line."""
         n = max(self.num_syncs, 1)
+        c = self.client
+        kc_total = c.kc_hits + c.kc_misses
+        overlap = (max(0.0, 1.0 - self._wait_wall / self._rt_wall)
+                   if self._rt_wall > 0 else 0.0)
         return {"num_syncs": self.num_syncs,
-                "bytes_push": self.client.bytes_push,
-                "bytes_pull": self.client.bytes_pull,
-                "bytes_per_sync": (self.client.bytes_push
-                                   + self.client.bytes_pull) / n}
+                "bytes_push": c.bytes_push,
+                "bytes_pull": c.bytes_pull,
+                "bytes_per_sync": (c.bytes_push + c.bytes_pull) / n,
+                "async_sync": int(self.async_sync),
+                "sync_overlap_frac": round(overlap, 4),
+                "push_ms_per_sync": round(1e3 * self._push_s / n, 3),
+                "pull_ms_per_sync": round(1e3 * self._pull_s / n, 3),
+                "keycache": int(c.keycache),
+                "keycache_hits": c.kc_hits,
+                "keycache_misses": c.kc_misses,
+                "keycache_hit_rate": (round(c.kc_hits / kc_total, 4)
+                                      if kc_total else 0.0)}

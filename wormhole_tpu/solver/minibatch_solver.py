@@ -147,6 +147,11 @@ class MinibatchSolver:
         self.device_feed = _env_flag("WH_DEVICE_FEED", True)
         # early-stop hook: (pass progress, data_pass, type) -> bool
         self.stop_hook: Optional[Callable] = None
+        # PS barrier hook (SyncedStore.flush): called before eval,
+        # checkpoint saves, and predict so an async in-flight sync can't
+        # leave those reading a half-merged model; None in single-process
+        # runs (no PS plane) and the distributed runner wires it up
+        self.sync_flush: Optional[Callable] = None
         # per-op perf accounting (reference minibatch_solver.h:246-275 +
         # difacto async_sgd.h:108-127 style)
         self.perf = Perf(log=self._log)
@@ -181,21 +186,28 @@ class MinibatchSolver:
             self._log(f"[obs] run report written: {path}")
         return result
 
+    def _flush(self) -> None:
+        if self.sync_flush is not None:
+            self.sync_flush()
+
     def _run_passes(self, cfg) -> dict:
         result = {}
         for dp in range(cfg.max_data_pass):
             tr = self.iterate(cfg.train_data, WorkType.TRAIN, dp)
             result["train"] = tr
+            self._flush()  # pass boundary: all of this pass is merged
             if cfg.val_data:
                 vl = self.iterate(cfg.val_data, WorkType.VAL, dp)
                 result["val"] = vl
             if cfg.model_out and cfg.save_iter > 0 and (
                 (dp + 1) % cfg.save_iter == 0 and dp + 1 < cfg.max_data_pass
             ):
+                self._flush()
                 ckpt.save_model(self._ckpt_store, cfg.model_out, dp)
             if self._should_stop(result, dp):
                 self._log(f"early stop after pass {dp}")
                 break
+        self._flush()
         if cfg.model_out:
             ckpt.save_model(self._ckpt_store, cfg.model_out)
         if getattr(cfg, "predict_out", None):
