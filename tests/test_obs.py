@@ -352,8 +352,8 @@ def test_obs_smoke_linear_job(tmp_path, retrace):
     lines = [json.loads(l) for l in open(obs_dir / traces[0])]
     assert lines[0]["ph"] == "M" and lines[0]["run"] == "smoke-run"
     spans = [l for l in lines if l.get("ph") == "X"]
-    assert any(l["name"] == "train_pass" for l in spans)
-    assert any(l["name"] == "train_step" for l in spans)
+    assert any(l["name"] == "solver.train_pass" for l in spans)
+    assert any(l["name"] == "solver.train_step" for l in spans)
     tv = _load_trace_viewer()
     assert tv.merge_traces([str(obs_dir / traces[0])])["traceEvents"]
 

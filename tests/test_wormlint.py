@@ -1,0 +1,369 @@
+"""wormlint checker tests: per-checker positive/negative fixtures (the
+bug pattern fires; the fixed or annotated version is clean), the
+annotation grammar, baseline round-trip, and suppression comments.
+
+Fixtures are in-memory sources run through ``analyze_sources`` — no
+filesystem or import of the checked code involved.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from tools.wormlint import analyze_sources
+from tools.wormlint.core import (load_baseline, match_baseline,
+                                 save_baseline)
+
+
+def _lint(src: str, path: str = "wormhole_tpu/fixture.py", *, only=None,
+          docs_text=None, extra=None):
+    sources = {path: textwrap.dedent(src)}
+    if extra:
+        sources.update({p: textwrap.dedent(s) for p, s in extra.items()})
+    return analyze_sources(sources, docs_text=docs_text,
+                           only=set(only) if only else None)
+
+
+def _keys(findings):
+    return {(f.checker, f.key) for f in findings}
+
+
+# --- lock-discipline --------------------------------------------------------
+
+_LOCK_RACY = """\
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.counts = {}
+            self.t = threading.Thread(target=self._loop, daemon=True)
+            self.t.start()
+
+        def _loop(self):
+            self.counts["x"] = 1
+
+        def snapshot(self):
+            with self._lock:
+                return dict(self.counts)
+    """
+
+
+def test_lock_discipline_flags_unguarded_foreign_write():
+    findings = _lint(_LOCK_RACY, only=["lock-discipline"])
+    assert ("lock-discipline", "Stats._loop:counts") in _keys(findings)
+
+
+def test_lock_discipline_clean_when_guarded():
+    fixed = _LOCK_RACY.replace(
+        '        self.counts["x"] = 1',
+        '        with self._lock:\n                self.counts["x"] = 1')
+    assert fixed != _LOCK_RACY
+    assert _lint(fixed, only=["lock-discipline"]) == []
+
+
+def test_lock_discipline_guarded_by_annotation():
+    annotated = _LOCK_RACY.replace(
+        '        self.counts["x"] = 1',
+        '        self.counts["x"] = 1  '
+        '# wormlint: guarded-by(self._lock)')
+    assert _lint(annotated, only=["lock-discipline"]) == []
+
+
+def test_lock_discipline_thread_owned_attr_annotation():
+    annotated = _LOCK_RACY.replace(
+        "        self.counts = {}",
+        "        self.counts = {}  # wormlint: thread-owned")
+    assert _lint(annotated, only=["lock-discipline"]) == []
+
+
+def test_lock_discipline_def_line_guarded_by():
+    # "caller holds the lock" on the def line covers the whole function
+    src = """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.q = {}
+                threading.Thread(target=self.run, daemon=True).start()
+
+            def run(self):
+                with self._lock:
+                    self._mutate()
+
+            def _mutate(self):  # wormlint: guarded-by(self._lock)
+                self.q["a"] = 1
+        """
+    assert _lint(src, only=["lock-discipline"]) == []
+    # without the annotation the transitive callee is flagged
+    bare = src.replace("  # wormlint: guarded-by(self._lock)", "")
+    assert ("lock-discipline", "C._mutate:q") in _keys(
+        _lint(bare, only=["lock-discipline"]))
+
+
+def test_lock_discipline_thread_entry_annotation_marks_entry():
+    # no Thread(...) in sight: the entry point is only known by annotation
+    src = """\
+        import threading
+
+        class H:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.seen = {}
+
+            def handle(self, k):  # wormlint: thread-entry
+                self.seen[k] = True
+        """
+    assert ("lock-discipline", "H.handle:seen") in _keys(
+        _lint(src, only=["lock-discipline"]))
+    bare = src.replace("  # wormlint: thread-entry", "")
+    assert _lint(bare, only=["lock-discipline"]) == []
+
+
+def test_lock_discipline_internally_synced_types_exempt():
+    src = """\
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self.q = queue.Queue()
+                threading.Thread(target=self.run, daemon=True).start()
+
+            def run(self):
+                self.q.put(1)
+        """
+    assert _lint(src, only=["lock-discipline"]) == []
+
+
+# --- env-knobs --------------------------------------------------------------
+
+def test_env_knobs_undeclared_read():
+    findings = _lint("""\
+        import os
+        TIMEOUT = os.environ.get("WH_TEST_BOGUS", "")
+        """, only=["env-knobs"])
+    assert ("env-knobs", "undeclared:WH_TEST_BOGUS") in _keys(findings)
+
+
+def test_env_knobs_declared_and_read_is_clean():
+    src = """\
+        import os
+        from wormhole_tpu.config import declare_knob, knob_value
+        declare_knob("WH_TEST_KNOB", int, 8, "a knob", group="data")
+        V = knob_value("WH_TEST_KNOB")
+        """
+    assert _lint(src, only=["env-knobs"],
+                 docs_text="... `WH_TEST_KNOB` ...") == []
+
+
+def test_env_knobs_declared_never_read():
+    src = """\
+        from wormhole_tpu.config import declare_knob
+        declare_knob("WH_TEST_DEAD", int, 8, "a knob", group="data")
+        """
+    assert ("env-knobs", "unread:WH_TEST_DEAD") in _keys(
+        _lint(src, only=["env-knobs"]))
+
+
+def test_env_knobs_undocumented():
+    src = """\
+        from wormhole_tpu.config import declare_knob, knob_value
+        declare_knob("WH_TEST_KNOB", int, 8, "a knob", group="data")
+        V = knob_value("WH_TEST_KNOB")
+        """
+    assert ("env-knobs", "undocumented:WH_TEST_KNOB") in _keys(
+        _lint(src, only=["env-knobs"], docs_text="nothing relevant"))
+    # tool-local knobs are exempt from the docs requirement
+    tools_src = src.replace('group="data"', 'group="tools"')
+    assert _lint(tools_src, only=["env-knobs"],
+                 docs_text="nothing relevant") == []
+
+
+def test_env_knobs_non_wh_names_out_of_scope():
+    src = """\
+        import os
+        P = os.environ.get("JAX_PLATFORMS", "")
+        """
+    assert _lint(src, only=["env-knobs"]) == []
+
+
+# --- metric-names -----------------------------------------------------------
+
+_NAMES = """\
+    COUNTERS = {"ps.client.retries": "client RPC retries"}
+    GAUGES = {}
+    HISTOGRAMS = {"perf.*_s": "per-op wall time"}
+    SPANS = {}
+    EVENTS = {}
+    """
+
+
+def _lint_metrics(emit_src: str, names_src: str = _NAMES):
+    return _lint(emit_src, path="wormhole_tpu/emit.py",
+                 only=["metric-names"],
+                 extra={"wormhole_tpu/obs/names.py": names_src})
+
+
+def test_metric_names_catches_emit_typo():
+    findings = _lint_metrics("""\
+        from wormhole_tpu.obs.metrics import REGISTRY
+        C = REGISTRY.counter("ps.client.retrys")
+        """)
+    keys = _keys(findings)
+    assert ("metric-names",
+            "unregistered:counter:ps.client.retrys") in keys
+    # the registered spelling is now unemitted: the registry can't rot
+    assert ("metric-names",
+            "unemitted:counter:ps.client.retries") in keys
+
+
+def test_metric_names_exact_and_wildcard_match():
+    findings = _lint_metrics("""\
+        from wormhole_tpu.obs.metrics import REGISTRY
+
+        def emit(op):
+            REGISTRY.counter("ps.client.retries").inc()
+            REGISTRY.histogram(f"perf.{op}_s").observe(0.1)
+        """)
+    assert findings == []
+
+
+def test_metric_names_convention_violation():
+    findings = _lint_metrics("""\
+        from wormhole_tpu.obs.metrics import REGISTRY
+        C = REGISTRY.counter("NotDotted")
+        """)
+    assert ("metric-names", "bad-format:counter:NotDotted") in _keys(
+        findings)
+
+
+def test_metric_names_missing_registry():
+    findings = _lint("""\
+        from wormhole_tpu.obs.metrics import REGISTRY
+        C = REGISTRY.counter("a.b")
+        """, only=["metric-names"])
+    assert ("metric-names", "missing-registry") in _keys(findings)
+
+
+# --- jit-purity -------------------------------------------------------------
+
+_JIT_IMPURE = """\
+    import jax
+
+    @jax.jit
+    def step(x):
+        print(x)
+        if x > 0:
+            return x
+        return -x
+    """
+
+
+def test_jit_purity_flags_side_effect_and_tracer_branch():
+    keys = _keys(_lint(_JIT_IMPURE, only=["jit-purity"]))
+    assert ("jit-purity", "step:side-effect:print") in keys
+    assert ("jit-purity", "step:tracer-branch:x") in keys
+
+
+def test_jit_purity_clean_static_and_shape_branches():
+    src = """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def step(x, mode):
+            jax.debug.print("tracing")
+            if mode == "train":
+                x = x + 1
+            if x.shape[0] > 2:
+                x = x * 2
+            if x is None:
+                return 0
+            return x
+        """
+    assert _lint(src, only=["jit-purity"]) == []
+
+
+def test_jit_purity_ignores_unjitted_functions():
+    src = _JIT_IMPURE.replace("    @jax.jit\n", "")
+    assert _lint(src, only=["jit-purity"]) == []
+
+
+# --- thread-lifecycle -------------------------------------------------------
+
+_THREAD_LEAK = """\
+    import threading
+
+    def spawn():
+        t = threading.Thread(target=print)
+        t.start()
+        return t
+    """
+
+
+def test_thread_lifecycle_flags_unjoined_nondaemon():
+    assert ("thread-lifecycle", "thread:t") in _keys(
+        _lint(_THREAD_LEAK, only=["thread-lifecycle"]))
+
+
+def test_thread_lifecycle_accepts_daemon_join_or_annotation():
+    daemon = _THREAD_LEAK.replace("target=print", "target=print, daemon=True")
+    joined = _THREAD_LEAK.replace("    return t",
+                                  "    t.join()\n    return t")
+    owned = _THREAD_LEAK.replace(
+        "t = threading.Thread(target=print)",
+        "t = threading.Thread(target=print)  # wormlint: thread-owned")
+    for src in (daemon, joined, owned):
+        assert _lint(src, only=["thread-lifecycle"]) == []
+
+
+# --- suppression ------------------------------------------------------------
+
+def test_disable_comment_suppresses_finding():
+    suppressed = _LOCK_RACY.replace(
+        '        self.counts["x"] = 1',
+        '        self.counts["x"] = 1  '
+        '# wormlint: disable=lock-discipline')
+    assert _lint(suppressed, only=["lock-discipline"]) == []
+    # the suppression is per-checker: other checkers still run
+    assert _lint(suppressed,
+                 only=["lock-discipline", "thread-lifecycle",
+                       "jit-purity", "env-knobs"]) == []
+
+
+# --- baseline round-trip ----------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    findings = _lint(_LOCK_RACY, only=["lock-discipline"])
+    assert findings
+    path = tmp_path / "baseline.json"
+    save_baseline(str(path), findings)
+    entries = load_baseline(str(path))
+    assert len(entries) == len(findings)
+
+    new, stale = match_baseline(findings, entries)
+    assert new == [] and stale == []
+
+    # baseline keys are line-insensitive: shifting the file keeps the match
+    shifted = "# a new leading comment\n" + textwrap.dedent(_LOCK_RACY)
+    moved = analyze_sources({"wormhole_tpu/fixture.py": shifted},
+                            only={"lock-discipline"})
+    assert [f.line for f in moved] != [f.line for f in findings]
+    new, stale = match_baseline(moved, entries)
+    assert new == [] and stale == []
+
+    # a fixed finding leaves its entry stale, never blocking
+    new, stale = match_baseline([], entries)
+    assert new == [] and stale == entries
+
+
+def test_baseline_rejects_malformed_entries(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"entries": [
+        {"checker": "lock-discipline", "path": "x.py", "key": "k"}
+    ]}))
+    with pytest.raises(ValueError, match="justification"):
+        load_baseline(str(path))

@@ -64,6 +64,15 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, REPO)
+
+from wormhole_tpu.config import declare_knob, knob_value
+
+declare_knob("WH_CHAOS_TIMEOUT_SEC", float, 300.0,
+             "Default per-scenario timeout for tools/chaos_lab.py "
+             "(overridden by --timeout).", group="tools")
+
 DEFAULT_SPECS = [
     "server:0:kill@push:30",
     "server:0:kill@pull:25",
@@ -174,7 +183,8 @@ def main(argv=None) -> int:
                     help="|logloss - baseline| above this flags "
                          "silent corruption (bounded-staleness runs "
                          "already wobble a little)")
-    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--timeout", type=float,
+                    default=knob_value("WH_CHAOS_TIMEOUT_SEC"))
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir (data + confs)")
     args = ap.parse_args(argv)

@@ -27,6 +27,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
+from wormhole_tpu.config import declare_knob, knob_value
+
+declare_knob("WH_LOADER_LAB_ROWS", int, 4096,
+             "Default synthetic row count for tools/loader_lab.py "
+             "(overridden by --rows).", group="tools")
+
 
 def _ms_per(fn, items, repeat=1):
     """Mean milliseconds per item of fn over items (materialized list)."""
@@ -41,7 +47,8 @@ def _ms_per(fn, items, repeat=1):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--rows", type=int,
+                    default=knob_value("WH_LOADER_LAB_ROWS"))
     ap.add_argument("--minibatch", type=int, default=512)
     ap.add_argument("--num-buckets", type=int, default=1 << 14)
     ap.add_argument("--nnz", type=int, default=16)

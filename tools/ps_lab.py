@@ -32,6 +32,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
+from wormhole_tpu.config import declare_knob, knob_value
+
+declare_knob("WH_PS_LAB_SYNCS", int, 4,
+             "Default number of sync rounds for tools/ps_lab.py "
+             "(overridden by --syncs).", group="tools")
+
 
 class _Store:
     """Host-numpy stand-in for the learner's KV store; records time
@@ -118,7 +124,7 @@ def main(argv=None):
                     help="table rows (bench operating point: 1<<26)")
     ap.add_argument("--nnz", type=int, default=100_000,
                     help="zipf draws per sync (bench point: 975000)")
-    ap.add_argument("--syncs", type=int, default=4)
+    ap.add_argument("--syncs", type=int, default=knob_value("WH_PS_LAB_SYNCS"))
     ap.add_argument("--servers", type=int, default=1)
     ap.add_argument("--compute-ms", type=float, default=50.0,
                     help="simulated device compute between async syncs")

@@ -1,0 +1,233 @@
+"""Shared infrastructure for the wormlint checkers.
+
+A checker is a function ``check(files: list[FileSource]) -> list[Finding]``
+run over the parsed file set. Findings are identified by a
+line-number-insensitive ``(checker, path, key)`` triple so the checked-in
+baseline survives unrelated edits.
+
+Annotation grammar (one directive per ``# wormlint:`` comment):
+
+    # wormlint: disable=<checker>[,<checker>...]   suppress this line
+    # wormlint: guarded-by(<lock expr>)            caller holds <lock> here
+    # wormlint: thread-owned                       attr/site confined to one
+                                                   thread by construction
+    # wormlint: thread-entry                       (on a def line) function
+                                                   runs on a foreign thread
+
+``disable=all`` suppresses every checker on that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Callable, Iterable, Optional
+
+CHECKERS = ("lock-discipline", "env-knobs", "metric-names", "jit-purity",
+            "thread-lifecycle")
+
+_DIRECTIVE_RE = re.compile(r"#\s*wormlint:\s*(.+?)\s*$")
+_GUARDED_BY_RE = re.compile(r"guarded-by\(([^)]+)\)")
+_DISABLE_RE = re.compile(r"disable=([\w,\-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    checker: str
+    path: str
+    line: int
+    key: str
+    message: str
+
+    @property
+    def ident(self) -> tuple[str, str, str]:
+        return (self.checker, self.path, self.key)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+@dataclasses.dataclass
+class Directives:
+    """Parsed ``# wormlint:`` directives for one source line."""
+
+    disabled: frozenset[str] = frozenset()
+    guarded_by: Optional[str] = None
+    thread_owned: bool = False
+    thread_entry: bool = False
+
+
+def _parse_directive(text: str) -> Directives:
+    d = Directives()
+    m = _DISABLE_RE.search(text)
+    if m:
+        d.disabled = frozenset(x.strip() for x in m.group(1).split(","))
+    m = _GUARDED_BY_RE.search(text)
+    if m:
+        d.guarded_by = m.group(1).strip()
+    if "thread-owned" in text:
+        d.thread_owned = True
+    if "thread-entry" in text:
+        d.thread_entry = True
+    return d
+
+
+class FileSource:
+    """One parsed source file plus its wormlint directives by line."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.directives: dict[int, Directives] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _DIRECTIVE_RE.search(line)
+            if m:
+                self.directives[i] = _parse_directive(m.group(1))
+
+    def directive(self, lineno: int) -> Directives:
+        return self.directives.get(lineno, _EMPTY)
+
+    def suppressed(self, lineno: int, checker: str) -> bool:
+        d = self.directives.get(lineno)
+        if d is None:
+            return False
+        return checker in d.disabled or "all" in d.disabled
+
+
+_EMPTY = Directives()
+
+
+def load_files(paths: Iterable[str],
+               on_error: Optional[Callable[[str, Exception], None]] = None,
+               ) -> list[FileSource]:
+    out = []
+    for root in paths:
+        for path in sorted(_iter_py(root)):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    out.append(FileSource(path, f.read()))
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                if on_error:
+                    on_error(path, e)
+        # keep path order deterministic across roots
+    return out
+
+
+def _iter_py(root: str) -> Iterable[str]:
+    if os.path.isfile(root):
+        if root.endswith(".py"):
+            yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if not d.startswith(".") and d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def apply_suppressions(files: list[FileSource],
+                       findings: list[Finding]) -> list[Finding]:
+    by_path = {f.path: f for f in files}
+    out = []
+    for f in findings:
+        src = by_path.get(f.path)
+        if src is not None and src.suppressed(f.line, f.checker):
+            continue
+        out.append(f)
+    return out
+
+
+# --- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> list[dict[str, str]]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data["entries"] if isinstance(data, dict) else data
+    for e in entries:
+        for field in ("checker", "path", "key", "justification"):
+            if field not in e:
+                raise ValueError(f"baseline entry missing {field!r}: {e}")
+    return entries
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    entries = [{"checker": f.checker, "path": f.path, "key": f.key,
+                "justification": "TODO: justify or fix"}
+               for f in sorted(findings, key=lambda f: f.ident)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"entries": entries}, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def match_baseline(findings: list[Finding], entries: list[dict[str, str]],
+                   ) -> tuple[list[Finding], list[dict[str, str]]]:
+    """Split findings into (new, ...) and return stale baseline entries."""
+    baselined = {(e["checker"], e["path"], e["key"]) for e in entries}
+    new = [f for f in findings if f.ident not in baselined]
+    hit = {f.ident for f in findings}
+    stale = [e for e in entries
+             if (e["checker"], e["path"], e["key"]) not in hit]
+    return new, stale
+
+
+# --- small AST helpers shared by checkers ----------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Rightmost identifier of a Name/Attribute chain ('c' for a.b.c)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def name_patterns(node: ast.AST) -> list[str]:
+    """Resolve a metric/span name argument to checkable patterns.
+
+    Constants give exact names; f-strings give fnmatch patterns with '*'
+    per interpolated field; IfExp over constants gives both arms. Anything
+    else (a variable) is unresolvable -> [].
+    """
+    s = const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return ["".join(parts)]
+    if isinstance(node, ast.IfExp):
+        return name_patterns(node.body) + name_patterns(node.orelse)
+    return []

@@ -1,0 +1,167 @@
+"""jit-purity: Python side effects and tracer branching inside @jax.jit.
+
+A jitted body only runs at trace time: host side effects (metric emission,
+``os.environ``, ``time.*``, printing, nonlocal/global writes) silently run
+once instead of per step, and ``if``/``while`` on a traced argument raises
+a ConcretizationTypeError at trace time. Both indicate code that belongs
+outside the jitted function.
+
+Detected jit forms: ``@jax.jit`` / ``@jit``, ``@partial(jax.jit, ...)``,
+and ``@jax.jit(...)`` decorator factories. ``static_argnames`` /
+``static_argnums`` parameters are exempt from the branching rule, as are
+``x is None`` checks and shape/dtype attribute access.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import FileSource, Finding, dotted_name, terminal_name
+
+CHECKER = "jit-purity"
+
+_IMPURE_ROOTS = {"time", "os", "random", "print", "open", "input",
+                 "REGISTRY", "logging", "logger"}
+_IMPURE_TRACE_ROOTS = {"_trace", "trace", "_obs"}
+_ALLOWED_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+_ALLOWED_CALLS = {"len", "isinstance", "callable", "static_field"}
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """Return the jit Call (for static-arg kwargs) or a sentinel if jitted."""
+    name = dotted_name(dec)
+    if name in ("jax.jit", "jit"):
+        return ast.Call(func=dec, args=[], keywords=[])
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in ("jax.jit", "jit"):
+            return dec
+        if terminal_name(dec.func) == "partial" and dec.args and \
+                dotted_name(dec.args[0]) in ("jax.jit", "jit"):
+            return dec
+    return None
+
+
+def _static_params(fn: ast.FunctionDef, jit_call: ast.Call) -> set[str]:
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    static.add(e.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, int) and e.value < len(params):
+                    static.add(params[e.value])
+    return static
+
+
+def _impure_call(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    root = name.split(".")[0]
+    if root in _IMPURE_ROOTS or root in _IMPURE_TRACE_ROOTS:
+        if name.startswith("jax.debug"):
+            return None
+        return name
+    return None
+
+
+class _ParentMap(ast.NodeVisitor):
+    def __init__(self):
+        self.parents: dict[ast.AST, ast.AST] = {}
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.parents[child] = node
+        super().generic_visit(node)
+
+
+def _tracer_branch(test: ast.AST, tracer_params: set[str]) -> Optional[str]:
+    """Param name concretely branched on in this If/While test, if any."""
+    # `x is None` / `x is not None` is a static (trace-time) check
+    if isinstance(test, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return None
+    pm = _ParentMap()
+    pm.visit(test)
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in tracer_params
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        parent = pm.parents.get(node)
+        if isinstance(parent, ast.Attribute) and \
+                parent.attr in _ALLOWED_ATTRS:
+            continue
+        if isinstance(parent, ast.Call) and node in parent.args and \
+                terminal_name(parent.func) in _ALLOWED_CALLS:
+            continue
+        if isinstance(parent, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+            continue
+        return node.id
+    return None
+
+
+def _check_body(src: FileSource, fn: ast.FunctionDef,
+                jit_call: ast.Call, findings: list[Finding]) -> None:
+    static = _static_params(fn, jit_call)
+    params = {a.arg for a in fn.args.posonlyargs + fn.args.args +
+              fn.args.kwonlyargs} - static - {"self"}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            impure = _impure_call(node)
+            if impure is not None:
+                findings.append(Finding(
+                    CHECKER, src.path, node.lineno,
+                    key=f"{fn.name}:side-effect:{impure}",
+                    message=(f"jitted `{fn.name}` calls `{impure}` — host "
+                             f"side effects run once at trace time, not "
+                             f"per step")))
+        elif isinstance(node, ast.Subscript) and \
+                terminal_name(node.value) == "environ":
+            findings.append(Finding(
+                CHECKER, src.path, node.lineno,
+                key=f"{fn.name}:side-effect:os.environ",
+                message=(f"jitted `{fn.name}` touches os.environ — read "
+                         f"knobs outside the jitted body")))
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            findings.append(Finding(
+                CHECKER, src.path, node.lineno,
+                key=f"{fn.name}:{kind}:{','.join(node.names)}",
+                message=(f"jitted `{fn.name}` declares {kind} "
+                         f"{', '.join(node.names)} — the mutation happens "
+                         f"at trace time only")))
+        elif isinstance(node, (ast.If, ast.While)):
+            hit = _tracer_branch(node.test, params)
+            if hit is not None:
+                findings.append(Finding(
+                    CHECKER, src.path, node.lineno,
+                    key=f"{fn.name}:tracer-branch:{hit}",
+                    message=(f"jitted `{fn.name}` branches concretely on "
+                             f"traced arg `{hit}` — use jnp.where/lax.cond "
+                             f"or mark it static_argnames")))
+
+
+def check(files: list[FileSource]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                jit_call = _jit_decorator(dec)
+                if jit_call is not None:
+                    if not src.suppressed(node.lineno, CHECKER):
+                        _check_body(src, node, jit_call, findings)
+                    break
+    return findings

@@ -1,0 +1,97 @@
+"""env-knobs: cross-check WH_*/WORMHOLE_* env reads against the registry.
+
+Declarations are ``declare_knob("WH_X", ...)`` calls (the central block in
+``wormhole_tpu/config.py`` plus tool-local blocks); reads are
+``os.environ.get/[]``, ``os.getenv``, ``os.environ.setdefault`` and the
+typed helpers ``env_flag``/``_env_flag``/``knob_value`` with a string
+literal argument. Only names matching ``WH_*`` / ``WORMHOLE_*`` are in
+scope (JAX/XLA variables belong to other projects).
+
+Findings: a read of an undeclared knob, a declared knob nothing reads,
+and a declared core knob missing from the docs/ tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .core import FileSource, Finding, const_str, terminal_name
+
+CHECKER = "env-knobs"
+
+_KNOB_RE = re.compile(r"^(WH_|WORMHOLE_)[A-Z0-9_]+$")
+_READ_HELPERS = {"env_flag", "_env_flag", "knob_value", "knob_flag"}
+
+
+def _env_read_name(call: ast.Call) -> Optional[str]:
+    """Knob name if this call reads an env var, else None."""
+    f = call.func
+    t = terminal_name(f)
+    if t in ("get", "setdefault") and isinstance(f, ast.Attribute) and \
+            terminal_name(f.value) == "environ" and call.args:
+        return const_str(call.args[0])
+    if t == "getenv" and call.args:
+        return const_str(call.args[0])
+    if t in _READ_HELPERS and call.args:
+        return const_str(call.args[0])
+    return None
+
+
+def collect(files: list[FileSource]):
+    """(declarations, reads): name -> (path, line, group) / list of sites."""
+    decls: dict[str, tuple[str, int, str]] = {}
+    reads: dict[str, list[tuple[str, int]]] = {}
+    for src in files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    terminal_name(node.value) == "environ":
+                name = const_str(node.slice)
+                if name and _KNOB_RE.match(name):
+                    reads.setdefault(name, []).append((src.path, node.lineno))
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) == "declare_knob" and node.args:
+                name = const_str(node.args[0])
+                if name:
+                    group = "runtime"
+                    for kw in node.keywords:
+                        if kw.arg == "group":
+                            group = const_str(kw.value) or group
+                    if len(node.args) >= 5:
+                        group = const_str(node.args[4]) or group
+                    decls.setdefault(name, (src.path, node.lineno, group))
+                continue
+            name = _env_read_name(node)
+            if name and _KNOB_RE.match(name):
+                reads.setdefault(name, []).append((src.path, node.lineno))
+    return decls, reads
+
+
+def check(files: list[FileSource],
+          docs_text: Optional[str] = None) -> list[Finding]:
+    decls, reads = collect(files)
+    findings: list[Finding] = []
+    for name, sites in sorted(reads.items()):
+        if name in decls:
+            continue
+        path, line = sites[0]
+        findings.append(Finding(
+            CHECKER, path, line, key=f"undeclared:{name}",
+            message=(f"env knob `{name}` is read here but not declared via "
+                     f"declare_knob() in the registry")))
+    for name, (path, line, group) in sorted(decls.items()):
+        if name not in reads:
+            findings.append(Finding(
+                CHECKER, path, line, key=f"unread:{name}",
+                message=(f"env knob `{name}` is declared but nothing in the "
+                         f"scanned tree reads it")))
+        elif docs_text is not None and group != "tools" and \
+                name not in docs_text:
+            findings.append(Finding(
+                CHECKER, path, line, key=f"undocumented:{name}",
+                message=(f"env knob `{name}` is declared but never "
+                         f"mentioned under docs/")))
+    return findings

@@ -1,0 +1,406 @@
+"""lock-discipline: flag unguarded mutation of cross-thread state.
+
+Per class, the checker:
+
+1. collects *lock attributes* (``self.x = threading.Lock()/RLock()/
+   Condition()`` or an attribute whose name contains ``lock``/``cond``);
+2. collects *internally-synchronized attributes* (``queue.Queue``,
+   ``threading.Event``, ``ThreadPoolExecutor`` — their method calls are
+   safe, rebinding is not);
+3. finds *thread-entry* functions: methods or nested closures passed to
+   ``threading.Thread(target=...)`` or ``<executor>.submit(...)``, plus any
+   ``def`` carrying ``# wormlint: thread-entry``;
+4. closes over ``self.method()`` calls from entry functions (a method
+   reachable from a foreign thread is foreign too);
+5. collects the set of instance attributes *mutated from foreign context*
+   (assign / augassign / subscript-store / known mutator-method call);
+6. flags every mutation of those attributes — in any method, foreign or
+   not, since a race needs two sides — that is not inside a
+   ``with <lock>`` block. ``__init__`` is exempt (happens-before thread
+   start), as are sites annotated ``guarded-by(...)`` / ``thread-owned``
+   and attributes whose ``__init__`` assignment is annotated
+   ``thread-owned``.
+
+Nested thread closures additionally may not mutate enclosing-scope locals
+(``shared.append(...)``) unless the local is itself a synchronized object
+or the site is annotated.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import FileSource, Finding, dotted_name, terminal_name
+
+CHECKER = "lock-discipline"
+
+_MUTATORS = {"append", "extend", "add", "update", "pop", "popleft", "clear",
+             "discard", "remove", "insert", "setdefault", "appendleft"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_SYNCED_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                 "Event", "ThreadPoolExecutor", "Barrier", "deque"}
+_CONTAINER_CTORS = {"list", "dict", "set", "defaultdict", "OrderedDict",
+                    "Counter"}
+
+
+def _is_lockish_name(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or "cond" in low or low == "mutex"
+
+
+def _lockish_expr(node: ast.AST, lock_attrs: set[str]) -> bool:
+    """True if a `with` context expr looks like acquiring a lock."""
+    t = terminal_name(node)
+    if t is None:
+        if isinstance(node, ast.Call):
+            return _lockish_expr(node.func, lock_attrs)
+        return False
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self" and node.attr in lock_attrs:
+        return True
+    return _is_lockish_name(t)
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.methods: dict[str, ast.FunctionDef] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        self.lock_attrs: set[str] = set()
+        self.synced_attrs: set[str] = set()
+        self.container_attrs: set[str] = set()
+        self.thread_owned_attrs: set[str] = set()
+        self.entry_funcs: set[ast.AST] = set()
+
+
+class _Mutation:
+    __slots__ = ("attr", "func_name", "line", "kind", "guards", "foreign",
+                 "directive", "func_covered")
+
+    def __init__(self, attr, func_name, line, kind, guards, foreign,
+                 directive, func_covered):
+        self.attr = attr
+        self.func_name = func_name
+        self.line = line
+        self.kind = kind  # 'assign' | 'call'
+        self.guards = guards  # list of with-exprs active at the site
+        self.foreign = foreign
+        self.directive = directive
+        self.func_covered = func_covered  # def-line guarded-by/thread-owned
+
+
+def _func_defs(node: ast.AST):
+    for child in ast.walk(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield child
+
+
+def _collect_class(src: FileSource, cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls)
+    for fn in info.methods.values():
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for tgt in stmt.targets:
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                attr = tgt.attr
+                val = stmt.value
+                ctor = None
+                if isinstance(val, ast.Call):
+                    ctor = terminal_name(val.func)
+                if ctor in _LOCK_CTORS or _is_lockish_name(attr):
+                    info.lock_attrs.add(attr)
+                elif ctor in _SYNCED_CTORS:
+                    info.synced_attrs.add(attr)
+                elif ctor in _CONTAINER_CTORS or isinstance(
+                        val, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+                    info.container_attrs.add(attr)
+                if fn.name == "__init__" and \
+                        src.directive(stmt.lineno).thread_owned:
+                    info.thread_owned_attrs.add(attr)
+    return info
+
+
+def _entry_targets(call: ast.Call) -> list[ast.AST]:
+    """Callables handed to a thread: Thread(target=...), pool.submit(f)."""
+    fname = terminal_name(call.func)
+    out: list[ast.AST] = []
+    if fname == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                out.append(kw.value)
+    elif fname == "submit" and isinstance(call.func, ast.Attribute):
+        if call.args:
+            out.append(call.args[0])
+    return out
+
+
+def _mark_entries(src: FileSource, info: _ClassInfo) -> None:
+    # explicit annotations on def lines
+    for fn in _func_defs(info.node):
+        if src.directive(fn.lineno).thread_entry:
+            info.entry_funcs.add(fn)
+    # Thread(target=...) / submit(...) wiring anywhere in the class
+    local_defs: dict[int, dict[str, ast.AST]] = {}
+
+    def defs_in(scope: ast.AST) -> dict[str, ast.AST]:
+        key = id(scope)
+        if key not in local_defs:
+            d = {}
+            for child in ast.walk(scope):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and child is not scope:
+                    d[child.name] = child
+            local_defs[key] = d
+        return local_defs[key]
+
+    for fn in info.methods.values():
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            for target in _entry_targets(call):
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    m = info.methods.get(target.attr)
+                    if m is not None:
+                        info.entry_funcs.add(m)
+                elif isinstance(target, ast.Name):
+                    local = defs_in(fn).get(target.id)
+                    if local is not None:
+                        info.entry_funcs.add(local)
+    # fixpoint: self.m() called from a foreign function is foreign
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(info.entry_funcs):
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and f.value.id == "self":
+                    m = info.methods.get(f.attr)
+                    if m is not None and m not in info.entry_funcs and \
+                            m.name != "__init__":
+                        info.entry_funcs.add(m)
+                        changed = True
+
+
+class _SiteVisitor(ast.NodeVisitor):
+    """Walk one method, tracking the with-stack, recording mutations."""
+
+    def __init__(self, src: FileSource, info: _ClassInfo,
+                 method: ast.FunctionDef, foreign_funcs: set[ast.AST]):
+        self.src = src
+        self.info = info
+        self.method = method
+        self.foreign_funcs = foreign_funcs
+        self.with_stack: list[ast.AST] = []
+        self.func_stack: list[ast.AST] = [method]
+        self.mutations: list[_Mutation] = []
+        # locals assigned per function scope, for closure-local analysis
+        self.local_muts: list[tuple[str, int, list[ast.AST], ast.AST]] = []
+        self.synced_locals: set[str] = set()
+
+    # -- scope/with tracking
+    def visit_With(self, node: ast.With):
+        self.with_stack.append(node)
+        self.generic_visit(node)
+        self.with_stack.pop()
+
+    def _visit_func(self, node):
+        if node is not self.method:
+            self.func_stack.append(node)
+            # a nested def inside a foreign function runs on that thread
+            if self.func_stack[-2] in self.foreign_funcs:
+                self.foreign_funcs.add(node)
+            self.generic_visit(node)
+            self.func_stack.pop()
+        else:
+            self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node)
+
+    # -- helpers
+    def _foreign(self) -> bool:
+        return any(f in self.foreign_funcs for f in self.func_stack)
+
+    def _func_directive(self, field: str) -> bool:
+        """A guarded-by/thread-owned directive on an enclosing def line
+        covers the whole body ("caller holds the lock" / "state this
+        function touches is partitioned by construction")."""
+        for f in self.func_stack:
+            d = self.src.directive(f.lineno)
+            if getattr(d, field):
+                return True
+        return False
+
+    def _guards(self) -> list[ast.AST]:
+        out = []
+        for w in self.with_stack:
+            for item in w.items:
+                out.append(item.context_expr)
+        return out
+
+    def _record_attr(self, attr: str, line: int, kind: str):
+        covered = (self._func_directive("guarded_by")
+                   or self._func_directive("thread_owned"))
+        self.mutations.append(_Mutation(
+            attr, self.method.name, line, kind, self._guards(),
+            self._foreign(), self.src.directive(line), covered))
+
+    # -- mutation collection
+    def _self_attr(self, node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        return None
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._target(tgt, node.lineno)
+        # synchronized locals: q = queue.Queue() etc.
+        if isinstance(node.value, ast.Call):
+            ctor = terminal_name(node.value.func)
+            if ctor in _SYNCED_CTORS or ctor in _LOCK_CTORS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.synced_locals.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def _target(self, tgt: ast.AST, line: int):
+        attr = self._self_attr(tgt)
+        if attr is not None:
+            self._record_attr(attr, line, "assign")
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            while isinstance(base, ast.Subscript):  # self.t[k][u] = ...
+                base = base.value
+            attr = self._self_attr(base)
+            if attr is not None:
+                self._record_attr(attr, line, "assign")
+            elif isinstance(base, ast.Name):
+                self._local_mut(base.id, line)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._target(elt, line)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = self._self_attr(f.value)
+            if attr is not None:
+                # mutator methods only count on known builtin containers;
+                # custom objects (Perf, PSClient, ...) own their locking
+                if attr in self.info.container_attrs and \
+                        attr not in self.info.synced_attrs:
+                    self._record_attr(attr, node.lineno, "call")
+            elif isinstance(f.value, ast.Name):
+                self._local_mut(f.value.id, node.lineno)
+        self.generic_visit(node)
+
+    def _local_mut(self, name: str, line: int):
+        # only meaningful inside a nested (closure) function: mutation of an
+        # enclosing-scope local shared with the spawning thread. If the
+        # method itself is foreign, its closures run on the same thread.
+        if len(self.func_stack) > 1 and \
+                self.func_stack[-1] in self.foreign_funcs and \
+                self.func_stack[0] not in self.foreign_funcs and \
+                name not in self.synced_locals:
+            inner = self.func_stack[-1]
+            own = {a.arg for a in inner.args.args}
+            own |= {n.id for st in ast.walk(inner)
+                    for n in (st.targets if isinstance(st, ast.Assign) else [])
+                    if isinstance(n, ast.Name)}
+            if name not in own:
+                if self._func_directive("thread_owned"):
+                    return
+                self.local_muts.append(
+                    (name, line, self._guards(), inner))
+
+
+def _guarded(guards: list[ast.AST], lock_attrs: set[str]) -> bool:
+    return any(_lockish_expr(g, lock_attrs) for g in guards)
+
+
+def check(files: list[FileSource]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(src, node))
+    return findings
+
+
+def _check_class(src: FileSource, cls: ast.ClassDef) -> list[Finding]:
+    info = _collect_class(src, cls)
+    _mark_entries(src, info)
+    if not info.entry_funcs:
+        return []
+
+    all_mutations: list[_Mutation] = []
+    local_findings: list[Finding] = []
+    for method in info.methods.values():
+        v = _SiteVisitor(src, info, method, set(info.entry_funcs))
+        v.visit(method)
+        all_mutations.extend(v.mutations)
+        for name, line, guards, inner in v.local_muts:
+            if _guarded(guards, info.lock_attrs):
+                continue
+            d = src.directive(line)
+            if d.thread_owned or d.guarded_by:
+                continue
+            local_findings.append(Finding(
+                CHECKER, src.path, line,
+                key=f"{cls.name}.{method.name}:<local {name}>",
+                message=(f"closure `{inner.name}` runs on a worker thread "
+                         f"and mutates enclosing local `{name}` without a "
+                         f"lock")))
+
+    # attributes touched from foreign context are the racy set
+    racy = {m.attr for m in all_mutations if m.foreign
+            if m.func_name != "__init__"}
+    racy -= info.lock_attrs
+    racy -= info.thread_owned_attrs
+
+    findings = list(local_findings)
+    seen: set[tuple[str, str]] = set()
+    for m in all_mutations:
+        if m.attr not in racy or m.func_name == "__init__":
+            continue
+        if _guarded(m.guards, info.lock_attrs):
+            continue
+        if m.directive.thread_owned or m.directive.guarded_by or \
+                m.func_covered:
+            continue
+        key = (f"{cls.name}.{m.func_name}", m.attr)
+        if key in seen:
+            continue
+        seen.add(key)
+        side = "a worker-thread" if m.foreign else "the owning-thread"
+        findings.append(Finding(
+            CHECKER, src.path, m.line,
+            key=f"{cls.name}.{m.func_name}:{m.attr}",
+            message=(f"`self.{m.attr}` is written from a thread-entry path "
+                     f"of `{cls.name}` but this {side} write in "
+                     f"`{m.func_name}` is not inside a `with <lock>` "
+                     f"block")))
+    return findings

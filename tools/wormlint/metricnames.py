@@ -1,0 +1,155 @@
+"""metric-names: cross-check emit sites against wormhole_tpu/obs/names.py.
+
+The registry module is parsed statically (never imported): the dict
+literals COUNTERS/GAUGES/HISTOGRAMS/SPANS/EVENTS map names — with ``*``
+wildcards for f-string interpolations — to doc strings.
+
+Emit sites are ``REGISTRY.counter/gauge/histogram("...")`` handles,
+``trace.span("...")`` / ``trace.event("...")`` and ``emit_span("...")``
+calls. Constant names check exactly; f-strings check as patterns; variable
+names are unresolvable and skipped.
+
+Findings: emit of an unregistered name, a name violating the dotted
+lowercase convention, and a registered name nothing emits.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Optional
+
+from .core import FileSource, Finding, name_patterns, terminal_name
+
+CHECKER = "metric-names"
+
+REGISTRY_DICTS = {
+    "COUNTERS": "counter",
+    "GAUGES": "gauge",
+    "HISTOGRAMS": "histogram",
+    "SPANS": "span",
+    "EVENTS": "event",
+}
+
+_METRIC_METHODS = {"counter": "counter", "gauge": "gauge",
+                   "histogram": "histogram", "timer": "histogram"}
+_TRACE_ROOTS = {"_trace", "trace", "obs_trace"}
+
+# lowercase dotted segments; '*' only as a whole-field wildcard inside a
+# segment (from f-string interpolation)
+_NAME_RE = re.compile(r"^[a-z0-9_*]+(\.[a-z0-9_*]+)+$")
+
+
+def parse_registry(src: FileSource) -> dict[str, set[str]]:
+    """kind -> registered name set, from the names.py dict literals."""
+    out: dict[str, set[str]] = {k: set() for k in
+                                ("counter", "gauge", "histogram",
+                                 "span", "event")}
+    for node in src.tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target]
+        value = node.value
+        if value is None or not isinstance(value, ast.Dict):
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id in REGISTRY_DICTS:
+                kind = REGISTRY_DICTS[tgt.id]
+                for k in value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        out[kind].add(k.value)
+    return out
+
+
+def _emit_site(call: ast.Call) -> Optional[tuple[str, ast.AST]]:
+    """(kind, name-arg) if this call emits/creates a named instrument."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or not call.args:
+        return None
+    if f.attr in _METRIC_METHODS and terminal_name(f.value) == "REGISTRY":
+        return _METRIC_METHODS[f.attr], call.args[0]
+    if f.attr in ("span", "event") and \
+            terminal_name(f.value) in _TRACE_ROOTS:
+        return f.attr, call.args[0]
+    if f.attr == "emit_span":
+        return "span", call.args[0]
+    return None
+
+
+def _matches(name: str, registered: set[str]) -> bool:
+    if name in registered:
+        return True
+    if "*" in name:
+        # emitted pattern: satisfied if some registered entry covers it or
+        # it covers a registered entry
+        return any(fnmatch.fnmatchcase(name, r) or
+                   fnmatch.fnmatchcase(r, name) for r in registered)
+    return any("*" in r and fnmatch.fnmatchcase(name, r)
+               for r in registered)
+
+
+def check(files: list[FileSource],
+          registry_path_suffix: str = "obs/names.py") -> list[Finding]:
+    reg_src = None
+    for src in files:
+        if src.path.replace("\\", "/").endswith(registry_path_suffix):
+            reg_src = src
+            break
+    findings: list[Finding] = []
+    if reg_src is None:
+        if files:
+            findings.append(Finding(
+                CHECKER, files[0].path, 1, key="missing-registry",
+                message=(f"no metric-name registry "
+                         f"({registry_path_suffix}) in the scanned tree")))
+        return findings
+    registered = parse_registry(reg_src)
+
+    for kind, names in registered.items():
+        for name in sorted(names):
+            if not _NAME_RE.match(name):
+                findings.append(Finding(
+                    CHECKER, reg_src.path, 1,
+                    key=f"bad-format:{kind}:{name}",
+                    message=(f"registered {kind} name `{name}` violates the "
+                             f"dotted lowercase convention")))
+
+    emitted: dict[str, set[str]] = {k: set() for k in registered}
+    for src in files:
+        if src is reg_src:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            site = _emit_site(node)
+            if site is None:
+                continue
+            kind, arg = site
+            for name in name_patterns(arg):
+                emitted[kind].add(name)
+                if not _NAME_RE.match(name):
+                    findings.append(Finding(
+                        CHECKER, src.path, node.lineno,
+                        key=f"bad-format:{kind}:{name}",
+                        message=(f"{kind} name `{name}` violates the dotted "
+                                 f"lowercase convention (want "
+                                 f"`subsystem.thing`)")))
+                elif not _matches(name, registered[kind]):
+                    findings.append(Finding(
+                        CHECKER, src.path, node.lineno,
+                        key=f"unregistered:{kind}:{name}",
+                        message=(f"{kind} `{name}` is emitted here but not "
+                                 f"registered in obs/names.py (typo, or add "
+                                 f"it to the registry)")))
+
+    for kind, names in registered.items():
+        for name in sorted(names):
+            if not _matches(name, emitted[kind]):
+                findings.append(Finding(
+                    CHECKER, reg_src.path, 1, key=f"unemitted:{kind}:{name}",
+                    message=(f"registered {kind} `{name}` is never emitted "
+                             f"by the scanned tree")))
+    return findings

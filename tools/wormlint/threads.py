@@ -1,0 +1,82 @@
+"""thread-lifecycle: every Thread is daemon=True or provably joined.
+
+A non-daemon thread that is never joined keeps the process alive after
+main exits — the launcher's respawn loops turn that into a hang. The
+checker accepts, per ``threading.Thread(...)`` construction site:
+
+- ``daemon=True`` in the constructor call;
+- the construction result bound to a name (local or ``self.x``) that has
+  a ``.join(`` call or ``.daemon = True`` assignment somewhere in the
+  same file;
+- a ``# wormlint: thread-owned`` / ``disable=thread-lifecycle`` directive
+  on the construction line for lifetimes managed elsewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .core import FileSource, Finding, terminal_name
+
+CHECKER = "thread-lifecycle"
+
+
+def _thread_ctor(call: ast.Call) -> bool:
+    return terminal_name(call.func) == "Thread"
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return kw.value.value is True
+    return False
+
+
+def _bound_name(parents: dict, call: ast.Call) -> Optional[str]:
+    """'t' for `t = Thread(...)`, 'self.t' for `self.t = Thread(...)`."""
+    node = parents.get(call)
+    if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+        return None
+    tgt = node.targets[0]
+    if isinstance(tgt, ast.Name):
+        return tgt.id
+    if isinstance(tgt, ast.Attribute) and \
+            isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+        return f"self.{tgt.attr}"
+    return None
+
+
+def _managed_in_file(text: str, name: str) -> bool:
+    esc = re.escape(name)
+    return bool(re.search(rf"\b{esc}\.join\(", text) or
+                re.search(rf"\b{esc}\.daemon\s*=\s*True\b", text))
+
+
+def check(files: list[FileSource]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in files:
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and _thread_ctor(node)):
+                continue
+            if _daemon_true(node):
+                continue
+            d = src.directive(node.lineno)
+            if d.thread_owned:
+                continue
+            bound = _bound_name(parents, node)
+            if bound is not None and _managed_in_file(src.text, bound):
+                continue
+            where = bound or "<unbound>"
+            findings.append(Finding(
+                CHECKER, src.path, node.lineno,
+                key=f"thread:{where}",
+                message=(f"Thread bound to `{where}` is neither daemon=True "
+                         f"nor joined/daemonized anywhere in this file — "
+                         f"it can outlive main")))
+    return findings

@@ -690,11 +690,11 @@ def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass,
     prog = Progress()
     train = wtype == WorkType.TRAIN
     step = learner.train_batch if train else learner.eval_batch
-    span_name = "train_step" if train else "eval_step"
+    span_name = "solver.train_step" if train else "solver.eval_step"
     while (got := pool.get()) is not None:
         part_id, f = got
         part_prog: dict = {}
-        with _trace.span("part", cat="solver", part=part_id,
+        with _trace.span("solver.part", cat="solver", part=part_id,
                          data_pass=data_pass):
             for blk in MinibatchIter(
                 f.filename, f.part, f.num_parts, f.format,

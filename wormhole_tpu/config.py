@@ -12,6 +12,7 @@ into lists (protobuf repeated-field semantics, used for e.g. multiple
 from __future__ import annotations
 
 import dataclasses
+import os
 import shlex
 from typing import Any, Optional, get_args, get_origin
 
@@ -108,6 +109,194 @@ def apply_config(cls, kv: dict[str, list[str]]):
     if unknown:
         raise ValueError(f"unknown config keys: {unknown} for {cls.__name__}")
     return cls(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Environment-knob registry
+#
+# Every `WH_*` / `WORMHOLE_*` environment variable the codebase reads must be
+# declared here (or, for tool-local knobs, in the tool that owns it) via
+# declare_knob().  The registry is the single source of truth for name, type,
+# default, and doc line: `tools/wormlint` statically cross-checks declarations
+# against read sites, and the docs tables in docs/distributed.md /
+# docs/data_pipeline.md are generated from it (knob_table_markdown, or
+# `python -m tools.wormlint --knob-docs <group>`).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    """One declared environment knob."""
+
+    name: str
+    type: type
+    default: Any
+    doc: str
+    group: str = "runtime"
+
+
+KNOBS: dict[str, EnvKnob] = {}
+
+
+def declare_knob(name: str, type: type, default: Any, doc: str,
+                 group: str = "runtime") -> EnvKnob:
+    """Register an env knob. Idempotent for identical re-declarations;
+    conflicting re-declaration is a bug and raises."""
+    knob = EnvKnob(name, type, default, doc, group)
+    prev = KNOBS.get(name)
+    if prev is not None and prev != knob:
+        raise ValueError(f"env knob {name} re-declared with a different spec: "
+                         f"{prev} vs {knob}")
+    KNOBS[name] = knob
+    return knob
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Truthy-string env read shared by all boolean knobs (the historical
+    `_env_flag` helpers in ps_server/minibatch_solver now alias this)."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("", "0", "false", "off")
+
+
+def knob_value(name: str) -> Any:
+    """Typed read of a declared knob: env value converted to the declared
+    type, or the declared default when unset/empty."""
+    knob = KNOBS[name]
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return knob.default
+    if knob.type is bool:
+        return raw.lower() not in ("", "0", "false", "off")
+    return knob.type(raw)
+
+
+def _fmt_default(knob: EnvKnob) -> str:
+    if knob.default is None:
+        return "(unset)"
+    if knob.type is str and knob.default == "":
+        return '`""`'
+    return f"`{knob.default}`"
+
+
+def knob_table_markdown(group: Optional[str] = None) -> str:
+    """Render the declared knobs (optionally one group) as a Markdown table."""
+    rows = sorted((k for k in KNOBS.values()
+                   if group is None or k.group == group),
+                  key=lambda k: k.name)
+    lines = ["| Knob | Type | Default | Description |",
+             "| --- | --- | --- | --- |"]
+    for k in rows:
+        lines.append(f"| `{k.name}` | {k.type.__name__} | {_fmt_default(k)} "
+                     f"| {k.doc} |")
+    return "\n".join(lines)
+
+
+# --- core knob declarations (grouped; tools declare their own locally) -----
+
+# runtime topology — set by launcher/dmlc_tpu.py contract(), read at node start
+declare_knob("WH_ROLE", str, None,
+             "Node role (`scheduler`/`server`/`worker`); set by the launcher.",
+             group="runtime")
+declare_knob("WH_RANK", int, 0,
+             "Rank of this node within its role group.", group="runtime")
+declare_knob("WH_NUM_WORKERS", int, 1,
+             "Worker count the scheduler waits for.", group="runtime")
+declare_knob("WH_NUM_SERVERS", int, 1,
+             "Server count the scheduler waits for.", group="runtime")
+declare_knob("WH_SCHEDULER_URI", str, "",
+             "host:port of the scheduler RPC endpoint.", group="runtime")
+declare_knob("WH_COORD_URI", str, "",
+             "host:port of the coordination endpoint handed to nodes.",
+             group="runtime")
+declare_knob("WH_NODE_TIMEOUT", float, 30.0,
+             "Seconds without a heartbeat before the scheduler evicts a node.",
+             group="runtime")
+
+# fault tolerance / recovery
+declare_knob("WH_FAULT_SPEC", str, "",
+             "Fault-injection spec (`kind:role:rank:when`, see "
+             "runtime/faults.py); empty disables injection.", group="faults")
+declare_knob("WH_RESTORE_EPOCH", int, 0,
+             "Epoch to restore server shards from after a respawn.",
+             group="faults")
+declare_knob("WH_SNAPSHOT_DIR", str, "",
+             "Directory for epoch-stamped PS shard snapshots; empty disables.",
+             group="faults")
+declare_knob("WH_PS_RETRY_SEC", float, 0.0,
+             "Client-side PS reconnect window in seconds (0 = fail fast).",
+             group="faults")
+
+# observability
+declare_knob("WH_OBS_DIR", str, "",
+             "Directory for trace-span JSONL and run_report.json; empty "
+             "disables file output.", group="obs")
+declare_knob("WH_RUN_ID", str, None,
+             "Run identifier stamped into traces/reports; generated by the "
+             "launcher when unset.", group="obs")
+
+# data pipeline
+declare_knob("WH_PACK_CACHE", bool, False,
+             "Enable the packed-batch epoch cache.", group="data")
+declare_knob("WH_PACK_CACHE_DIR", str, None,
+             "Disk tier directory for the pack cache; unset = memory only.",
+             group="data")
+declare_knob("WH_PACK_CACHE_MB", int, 512,
+             "Memory-tier byte budget for the pack cache, in MiB.",
+             group="data")
+declare_knob("WH_NUM_LOADERS", int, None,
+             "Pin the loader thread-pool size (disables adaptive sizing "
+             "unless WH_ADAPTIVE_LOADERS overrides).", group="data")
+declare_knob("WH_ADAPTIVE_LOADERS", bool, True,
+             "Stall-driven loader pool resizing between passes (defaults on "
+             "unless WH_NUM_LOADERS pins the size).", group="data")
+declare_knob("WH_DEVICE_FEED", bool, True,
+             "Loader-side device staging (double-buffered feed).",
+             group="data")
+
+# PS sync plane
+declare_knob("WH_ASYNC_SYNC", bool, False,
+             "Overlap PS push/pull with compute on a background comms thread.",
+             group="ps")
+declare_knob("WH_KEYCACHE", bool, False,
+             "Key-list digest caching on the PS wire (resend on miss).",
+             group="ps")
+
+# kernel tuning (WORMHOLE_* block-size overrides for Pallas kernels)
+declare_knob("WORMHOLE_TILE_HI", int, 512,
+             "Sublanes per tile in the COO kernels.", group="kernel")
+declare_knob("WORMHOLE_BLK", int, 4096,
+             "Nonzeros per grid block in the COO kernels.", group="kernel")
+declare_knob("WORMHOLE_FM_BLK", int, 1024,
+             "FM kernel block size.", group="kernel")
+declare_knob("WORMHOLE_FM_VMEM", int, 64 * 2**20,
+             "FM kernel VMEM budget in bytes.", group="kernel")
+declare_knob("WORMHOLE_VMEM", int, 96 * 2**20,
+             "COO kernel VMEM budget in bytes.", group="kernel")
+declare_knob("WORMHOLE_BLK_U", int, 1024,
+             "Update-kernel block size.", group="kernel")
+declare_knob("WORMHOLE_HIST_FGROUP", int, 7,
+             "Features per group in the GBDT histogram kernel.",
+             group="kernel")
+
+# debug / native escape hatches
+declare_knob("WORMHOLE_STACKDUMP", bool, False,
+             "Install a SIGUSR1 stack-dump handler at import.", group="debug")
+declare_knob("WORMHOLE_DEBUG", bool, False,
+             "Verbose debug printing in the GBDT trainer.", group="debug")
+declare_knob("WORMHOLE_NO_NATIVE", bool, False,
+             "Skip loading the native acceleration library.", group="debug")
+declare_knob("WORMHOLE_NATIVE_LIB", str, None,
+             "Explicit path to the native library (overrides discovery).",
+             group="debug")
+declare_knob("WORMHOLE_PROFILE_DIR", str, None,
+             "Directory for utils/perf.py profile dumps.", group="debug")
+
+# tools (cross-tool knobs owned by the core registry)
+declare_knob("WH_CRITEO_DIR", str, "data",
+             "Criteo dataset directory for tools/criteo_kaggle_parity.py.",
+             group="tools")
 
 
 def config_to_text(cfg) -> str:

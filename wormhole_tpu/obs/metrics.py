@@ -49,7 +49,7 @@ class Counter:
         self._value = 0
         self._lock = threading.Lock()
 
-    def inc(self, n: int = 1) -> None:
+    def inc(self, n: int = 1) -> None:  # wormlint: thread-entry
         with self._lock:
             self._value += n
 
@@ -68,7 +68,7 @@ class Gauge:
         self._value = 0.0
         self._lock = threading.Lock()
 
-    def set(self, v: float) -> None:
+    def set(self, v: float) -> None:  # wormlint: thread-entry
         with self._lock:
             self._value = float(v)
 
@@ -96,7 +96,7 @@ class Histogram:
         self._rng = random.Random(zlib.crc32(name.encode()))
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float) -> None:  # wormlint: thread-entry
         v = float(v)
         with self._lock:
             self.count += 1
@@ -140,21 +140,21 @@ class Registry:
         self._gauges: dict[str, Gauge] = {}
         self._hists: dict[str, Histogram] = {}
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str) -> Counter:  # wormlint: thread-entry
         with self._lock:
             c = self._counters.get(name)
             if c is None:
                 c = self._counters[name] = Counter(name)
             return c
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str) -> Gauge:  # wormlint: thread-entry
         with self._lock:
             g = self._gauges.get(name)
             if g is None:
                 g = self._gauges[name] = Gauge(name)
             return g
 
-    def histogram(self, name: str,
+    def histogram(self, name: str,  # wormlint: thread-entry
                   reservoir: int = DEFAULT_RESERVOIR) -> Histogram:
         with self._lock:
             h = self._hists.get(name)

@@ -1,0 +1,109 @@
+"""Registry of every metric, span, and event name the codebase emits.
+
+Names follow the dotted-namespace convention ``<subsystem>.<thing>[_<unit>]``
+(lowercase, segments separated by dots, underscores within a segment).
+Dynamic names built with f-strings are declared with a ``*`` wildcard per
+interpolated field, e.g. ``ps.server.op.*_s`` covers
+``f"ps.server.op.{op}_s"``.
+
+``tools/wormlint`` parses these dict literals statically (this module is
+never imported by the checker) and cross-checks them against every
+``REGISTRY.counter/gauge/histogram("...")``, ``trace.span("...")`` and
+``trace.event("...")`` call site: an emit of an unregistered name, a name
+violating the convention, or a registered name nothing emits are all
+findings.  To add a metric, add it here first — the doc string doubles as
+the metric's reference documentation.
+"""
+
+from __future__ import annotations
+
+# fmt: off
+COUNTERS: dict[str, str] = {
+    "ps.server.num_push": "pushes applied by a PS shard",
+    "ps.server.num_pull": "pulls served by a PS shard",
+    "ps.server.dedup_hits": "replayed pushes dropped by seq dedup",
+    "ps.server.snapshots": "shard snapshots written",
+    "ps.server.restores": "shard restores performed",
+    "ps.client.bytes_push": "payload bytes pushed to servers",
+    "ps.client.bytes_pull": "payload bytes pulled from servers",
+    "ps.client.retries": "client RPC retries after socket errors",
+    "ps.client.replays": "journal replays sent after reconnect",
+    "ps.client.replay_dedup": "replays the server acked as duplicates",
+    "ps.client.rollback_repulls": "full re-pulls forced by epoch rollback",
+    "ps.client.syncs": "SyncedStore sync() rounds",
+    "ps.keycache.hits": "key-list digests accepted by the server",
+    "ps.keycache.misses": "digest misses forcing a full key resend",
+    "ps.keycache.invalidations": "key caches dropped on restore/reconnect",
+    "sched.liveness_evictions": "nodes evicted by the liveness loop",
+    "sched.server_recoveries": "server re-registrations after death",
+    "net.frames_sent": "frames written to sockets",
+    "net.frames_recv": "frames read from sockets",
+    "net.bytes_sent": "bytes written to sockets",
+    "net.bytes_recv": "bytes read from sockets",
+    "net.connect_retries": "connect() attempts that needed a retry",
+    "kv.gather_rows": "rows gathered from the local kvstore",
+    "kv.scatter_rows": "rows scattered into the local kvstore",
+    "pack_cache.hits": "memory-tier pack cache hits",
+    "pack_cache.misses": "pack cache misses (batch re-packed)",
+    "pack_cache.disk_hits": "disk-tier pack cache hits",
+    "pack_cache.evictions": "LRU evictions from the memory tier",
+    "pack_cache.corrupt": "disk entries dropped after checksum failure",
+}
+
+GAUGES: dict[str, str] = {
+    "ps.server.restore_epoch": "epoch a shard last restored from",
+    "ps.sync.inflight": "async sync rounds currently in flight (0/1)",
+    "ps.sync.overlap_frac": "fraction of sync wall time hidden by compute",
+    "queue.depth": "loader output queue depth",
+    "loader.stall_s": "main-thread queue-wait total for the pass",
+    "loader.pool_size": "current loader thread-pool size",
+    "pack_cache.bytes": "bytes held by the pack cache memory tier",
+}
+
+HISTOGRAMS: dict[str, str] = {
+    "ps.server.snapshot_s": "shard snapshot write duration",
+    "ps.server.op.*_s": "per-op PS server handler duration",
+    "ps.client.rpc_s": "single client RPC round-trip",
+    "ps.client.sync_push_s": "push half of a sync round",
+    "ps.client.sync_pull_s": "pull half of a sync round",
+    "ps.client.sync_wait_s": "train-thread wait for the async comms thread",
+    "sched.barrier_wait_s": "scheduler-side barrier hold time",
+    "sched.op.*_s": "per-op scheduler handler duration",
+    "net.encode_s": "wire message encode duration",
+    "net.decode_s": "wire message decode duration",
+    "kv.gather_s": "local kvstore gather duration",
+    "kv.scatter_s": "local kvstore scatter duration",
+    "perf.*_s": "utils.perf mirror of ad-hoc timed ops",
+}
+
+SPANS: dict[str, str] = {
+    "ps.snapshot": "server-side shard snapshot",
+    "ps.sync.snapshot": "client-side delta snapshot under the store lock",
+    "ps.sync.push": "push half of a sync round",
+    "ps.sync.pull": "pull half of a sync round",
+    "rpc.*": "one client RPC, named by op",
+    "barrier.*": "scheduler barrier, named by barrier",
+    "solver.part": "one data part processed by a worker",
+    "solver.*_pass": "one train/eval pass over the data",
+    "solver.*_step": "one train/eval minibatch step",
+}
+
+EVENTS: dict[str, str] = {
+    "ps.restore": "server shard restored from snapshot",
+    "ps.rollback": "client detected server epoch rollback",
+    "ps.reconnect": "client reconnected to a respawned server",
+    "sched.server_recovered": "scheduler accepted a server re-registration",
+    "sched.liveness_evict": "scheduler evicted an unresponsive node",
+}
+# fmt: on
+
+ALL_METRICS: dict[str, dict[str, str]] = {
+    "counter": COUNTERS,
+    "gauge": GAUGES,
+    "histogram": HISTOGRAMS,
+}
+
+ALL_TRACE: dict[str, dict[str, str]] = {
+    "span": SPANS,
+    "event": EVENTS,
+}

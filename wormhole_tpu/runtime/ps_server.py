@@ -309,7 +309,7 @@ class ServerNode:
         lo, hi = shard_range(group, self.rank, self.world)
         return hi - lo
 
-    def _create_group_meta(self) -> None:
+    def _create_group_meta(self) -> None:  # wormlint: guarded-by(self._lock)
         """Version/dirty arrays for every row-space group (caller holds
         the lock, full_rows already set). uint32 clock stamps: 4
         bytes/row; push asserts the clock never reaches the wrap point
@@ -320,7 +320,8 @@ class ServerNode:
             self._reset_pushlog(g)
 
     # -- ops ----------------------------------------------------------------
-    def _dispatch(self, header: dict, arrays: dict) -> tuple[dict, dict]:
+    def _dispatch(self, header: dict,  # wormlint: thread-entry
+                  arrays: dict) -> tuple[dict, dict]:
         op = header.get("op")
         t0 = time.perf_counter()
         try:
@@ -623,7 +624,7 @@ class ServerNode:
     _LOG_ELEM_CAP = 1 << 23
     _LOG_ENTRY_CAP = 4096
 
-    def _log_push(self, g: int, idx) -> None:
+    def _log_push(self, g: int, idx) -> None:  # wormlint: guarded-by(self._lock)
         """Record a sparse push for O(pushed) pulls (lock held)."""
         arr = np.asarray(idx, np.int64)
         if arr.size == 0:
@@ -637,7 +638,7 @@ class ServerNode:
             self._log_elems[g] -= old.size
             self._log_start[g] = c
 
-    def _reset_pushlog(self, g: int) -> None:
+    def _reset_pushlog(self, g: int) -> None:  # wormlint: guarded-by(self._lock)
         """Version stamps changed outside push (load/spec stamp): the
         log no longer covers history before this clock (lock held)."""
         self._pushlog[g] = []
@@ -652,7 +653,7 @@ class ServerNode:
     _KC_CAP = 32
     _KC_KNOWN_CAP = 8
 
-    def _kc_resolve(self, sender: str, kdig: dict, idx_of: dict) -> list:
+    def _kc_resolve(self, sender: str, kdig: dict, idx_of: dict) -> list:  # wormlint: guarded-by(self._lock)
         """Adopt/resolve a push's key-list digests (lock held): a group
         whose idx array rode the frame is cached under its digest; a
         digest-only group is resolved from the cache into `idx_of`.
@@ -685,7 +686,7 @@ class ServerNode:
             known.popitem(last=False)
         return need
 
-    def _kc_pull_digest(self, sender: str,
+    def _kc_pull_digest(self, sender: str,  # wormlint: guarded-by(self._lock)
                         idx: np.ndarray) -> tuple[str, bool]:
         """Pull-reply half of the key cache (lock held): returns
         (digest, held) — `held` means the sender provably has this key
@@ -702,7 +703,7 @@ class ServerNode:
             known.popitem(last=False)
         return dig, False
 
-    def _kc_invalidate(self) -> None:
+    def _kc_invalidate(self) -> None:  # wormlint: guarded-by(self._lock)
         """Recovery-path cache discard (snapshot restore / checkpoint
         load): a rolled-back server must not resolve pre-crash digests
         (lock held)."""
@@ -711,7 +712,7 @@ class ServerNode:
         self._kc_idx = {}
         self._kc_known = {}
 
-    def _recompute_derived(self) -> None:
+    def _recompute_derived(self) -> None:  # wormlint: guarded-by(self._lock)
         """Recompute derived tables from their additive sources over the
         rows dirtied since the last recompute (caller holds the lock).
         FTRL's w is soft-threshold-nonlinear in (z, n), so additively
@@ -741,7 +742,7 @@ class ServerNode:
         for g in self._dirty:
             self._dirty[g] = []
 
-    def _load(self, base: str, it: Optional[int]) -> None:
+    def _load(self, base: str, it: Optional[int]) -> None:  # wormlint: guarded-by(self._lock)
         """Create this shard's tables from a checkpoint (caller holds the
         lock). When the checkpoint was written by a same-world server
         group, this server reads ONLY its own `_part-<rank>` file (the
@@ -813,7 +814,7 @@ class ServerNode:
             # must take the scan path
             self._reset_pushlog(g)
 
-    def _stamp_nonspec_groups(self, specs: dict) -> None:
+    def _stamp_nonspec_groups(self, specs: dict) -> None:  # wormlint: guarded-by(self._lock)
         """After a checkpoint load, groups holding non-zero-init tables
         must be stamped wholly dirty the first time a worker's init spec
         names them: the worker's seeded init differs from the loaded
@@ -928,7 +929,10 @@ class ServerNode:
         path = part_name(self._snap_base or "ps_snap", None,
                          self.rank) + ".npz"
         atomic_savez(path, compressed=True, **arrays)
-        self._snap_clock = clock
+        # only advance the skip-fence after the write landed; re-take the
+        # lock because restore_snapshot writes it from the serving threads
+        with self._lock:
+            self._snap_clock = clock
         return path
 
     def restore_snapshot(self, base: str) -> bool:
@@ -1058,7 +1062,7 @@ class PSClient:
         # only shared mutables are behind _stats_lock)
         self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 
-    def _file(self, r: int):
+    def _file(self, r: int):  # wormlint: thread-owned
         if self._files[r] is None:
             host, port = self.uris[r].rsplit(":", 1)
             s = connect_with_retry((host, int(port)), self.connect_deadline)
@@ -1079,7 +1083,7 @@ class PSClient:
         h, arrs, received = got
         return h, arrs, sent, received
 
-    def _note_epoch(self, r: int, h: dict) -> None:
+    def _note_epoch(self, r: int, h: dict) -> None:  # wormlint: thread-owned
         ep = h.get("epoch")
         if ep is None:
             return
@@ -1097,7 +1101,7 @@ class PSClient:
                   "full re-pull", flush=True)
         self._epochs[r] = ep
 
-    def _rpc(self, r: int, header: dict, arrays=None, fixed_bytes: int = 0,
+    def _rpc(self, r: int, header: dict, arrays=None, fixed_bytes: int = 0,  # wormlint: thread-owned
              compress: bool = False, journal_arrays=None):
         if compress:
             header = dict(header, comp_reply=1)
@@ -1170,7 +1174,7 @@ class PSClient:
                 self.bytes_init += sent + received
         return h, arrs
 
-    def _recover(self, r: int, op_name: str, err: Exception) -> None:
+    def _recover(self, r: int, op_name: str, err: Exception) -> None:  # wormlint: thread-owned
         """Reconnect to server r (re-resolving its URI when a resolver
         is available), fence with `hello`, and replay unacked journaled
         pushes. Raises with the resume guidance once `retry_deadline`
@@ -1207,7 +1211,8 @@ class PSClient:
                     r, {"op": "hello", "sender": self.sender}, None, 0,
                     False)
                 self._note_epoch(r, h)
-                self.num_retries += 1
+                with self._stats_lock:  # shared tally; fan threads race
+                    self.num_retries += 1
                 _RETRIES.inc()
                 _trace.event("ps.reconnect", cat="recovery", server=r,
                              uri=self.uris[r], epoch=self._epochs[r])
@@ -1258,7 +1263,7 @@ class PSClient:
                 self.close(r)
                 err = e2
 
-    def close(self, r: Optional[int] = None) -> None:
+    def close(self, r: Optional[int] = None) -> None:  # wormlint: thread-owned
         ranks = range(self.world) if r is None else [r]
         for i in ranks:
             try:
@@ -1282,7 +1287,9 @@ class PSClient:
         if self.world == 1:
             return [fn(0)]
         if self._pool is None:
-            self._pool = concurrent.futures.ThreadPoolExecutor(
+            # lazy init on the train thread only; close() tears it down
+            # after the last fan-out returned
+            self._pool = concurrent.futures.ThreadPoolExecutor(  # wormlint: thread-owned
                 max_workers=min(self.world, 8),
                 thread_name_prefix="ps-rpc")
         futs = [self._pool.submit(fn, r) for r in range(self.world)]
@@ -1374,7 +1381,8 @@ class PSClient:
         {table: rows aligned to its group's indices})."""
         kc = self.keycache and self.sender is not None
 
-        def one(r: int) -> tuple[dict, dict]:
+        def one(r: int) -> tuple[dict, dict]:  # wormlint: thread-entry thread-owned
+
             s = int(since[r])
             if self._rolled_back[r]:
                 # the server restored a snapshot: its clock (and row
@@ -1460,7 +1468,8 @@ class PSClient:
         respawned server) triggers a full resend under a fresh seq."""
         kc = self.keycache and self.sender is not None
 
-        def one(r: int) -> None:
+        def one(r: int) -> None:  # wormlint: thread-entry thread-owned
+
             sel: dict[int, slice] = {}
             loc_of: dict[int, np.ndarray] = {}
             kdig: dict[str, str] = {}
@@ -1779,11 +1788,11 @@ class SyncedStore:
                 t2 = time.perf_counter()
                 _SYNC_PUSH_S.observe(t1 - t0)
                 _SYNC_PULL_S.observe(t2 - t1)
-                self._push_s += t1 - t0
-                self._pull_s += t2 - t1
-                if self.perf is not None:
-                    self.perf.add("ps_push", t1 - t0)
-                    self.perf.add("ps_pull", t2 - t1)
+                # duration tallies ride the job dict and are folded by
+                # _fold_pending on the train thread (job["done"] is the
+                # fence), keeping _push_s/_pull_s/perf single-writer
+                job["push_s"] = t1 - t0
+                job["pull_s"] = t2 - t1
             except BaseException as e:  # surfaced at the next fold
                 job["error"] = e
             finally:
@@ -1810,6 +1819,12 @@ class SyncedStore:
             raise err
         self._wait_wall += waited
         self._rt_wall += job["rt"]
+        if "push_s" in job:
+            self._push_s += job["push_s"]
+            self._pull_s += job["pull_s"]
+            if self.perf is not None:
+                self.perf.add("ps_push", job["push_s"])
+                self.perf.add("ps_pull", job["pull_s"])
         _SYNC_WAIT_S.observe(waited)
         if self._rt_wall > 0:
             _SYNC_OVERLAP.set(

@@ -151,7 +151,8 @@ class Scheduler:
     def announce_shutdown(self) -> None:
         """Mark the job finished; workers see it on their next epoch poll
         and exit their dispatch loop."""
-        self._shutdown = True
+        with self._lock:
+            self._shutdown = True
 
     def stop(self) -> None:
         self._done = True
@@ -185,8 +186,10 @@ class Scheduler:
         (WorkloadPool.is_finished) rather than as an instantly-over
         round."""
         self.pool.clear()
-        self.progress = Progress()
         with self._lock:
+            # rebind under the lock: handler threads merge() into the
+            # current Progress and must not see a half-published swap
+            self.progress = Progress()
             self._epoch += 1
             self._round = dict(type=int(wtype), data_pass=data_pass)
             if local_data:
@@ -275,7 +278,7 @@ class Scheduler:
         return self.progress
 
     # -- RPC ops ------------------------------------------------------------
-    def _dispatch(self, req: dict) -> dict:
+    def _dispatch(self, req: dict) -> dict:  # wormlint: thread-entry
         op = req.get("op")
         t0 = time.perf_counter()
         try:

@@ -319,7 +319,9 @@ class MinibatchSolver:
                             return
                     pool.finish(part_id)
             except BaseException as e:
-                errors.append(e)
+                # CPython list.append is atomic; main thread reads only
+                # after every loader posted its _END sentinel
+                errors.append(e)  # wormlint: disable=lock-discipline
             finally:
                 _put(_END)
 
@@ -347,7 +349,7 @@ class MinibatchSolver:
             self._log(f"{mode} pass {data_pass}: {data}")
             self._log(Progress.header())
         try:
-            with _trace.span(f"{mode}_pass", cat="solver",
+            with _trace.span(f"solver.{mode}_pass", cat="solver",
                              data_pass=data_pass):
                 while done_loaders < len(threads):
                     depth = q.qsize()
@@ -365,7 +367,7 @@ class MinibatchSolver:
                         done_loaders += 1
                         continue
                     t_s = time.perf_counter()
-                    with _trace.span(f"{mode}_step", cat="solver"):
+                    with _trace.span(f"solver.{mode}_step", cat="solver"):
                         prog.merge(step(item))
                     dt = time.perf_counter() - t_s
                     self.perf.add(f"{mode}_step", dt)

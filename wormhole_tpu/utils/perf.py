@@ -46,10 +46,16 @@ class Perf:
         self._log_every = log_every
         self._since_log = 0
 
-    def add(self, op: str, sec: float) -> None:
+    def add(self, op: str, sec: float) -> None:  # wormlint: thread-entry
         h = self._hists.get(op)
         if h is None:
-            h = self._hists[op] = _obs.REGISTRY.histogram(f"perf.{op}_s")
+            # double-checked: the unlocked miss re-checks under the lock
+            # so two threads racing a new op share one mirror handle
+            with self._lock:
+                h = self._hists.get(op)
+                if h is None:
+                    h = self._hists[op] = _obs.REGISTRY.histogram(
+                        f"perf.{op}_s")
         h.observe(sec)
         with self._lock:
             self._sum[op] = self._sum.get(op, 0.0) + sec
