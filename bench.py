@@ -519,6 +519,79 @@ def bench_gbdt(rounds=8):
     return 1.0 / sec, n / sec
 
 
+# ------------------------------------------------------------- BSP ring
+def bench_bsp(workers=3):
+    """Fault-free overhead of the native BSP allreduce stack
+    (`bsp = 1`, launcher `-s 0`, runtime/allreduce.py): per-collective
+    ring time and per-checkpoint cost straight from the run report,
+    plus the wall-clock price of one worker kill + respawn
+    (recovery_overhead_s). chaos_lab verifies the recovered model is
+    bit-identical; this row prices the same machinery."""
+    import os
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.chaos_lab import run_bsp_job, synth_libsvm
+
+    rows = []
+    with tempfile.TemporaryDirectory() as td:
+        for p in range(workers):
+            synth_libsvm(f"{td}/train-{p}.libsvm", 400, seed=p)
+        synth_libsvm(f"{td}/val.libsvm", 200, seed=9)
+        jobs = [
+            ("gbdt", "wormhole_tpu.apps.gbdt",
+             [f"train_data={td}/train-.*", f"eval_data={td}/val.libsvm",
+              "bsp=1", "num_round=4", "max_depth=3", "max_bin=16",
+              "minibatch=256"],
+             "worker:1:kill@allreduce:6"),
+            ("lbfgs", "wormhole_tpu.apps.lbfgs_linear",
+             [f"data={td}/train-.*", "bsp=1", "max_lbfgs_iter=6",
+              "reg_L2=0.001", "minibatch=256"],
+             "worker:1:kill@allreduce:4"),
+        ]
+        for tag, module, app_args, kill in jobs:
+            # restarts=1 even fault-free: supervision is what arms the
+            # snapshot dir, and the checkpoint cost is part of the
+            # overhead being priced
+            rc, out, wall, rep = run_bsp_job(
+                module, app_args, "", workers=workers, restarts=1,
+                timeout=300, obs_dir=f"{td}/obs_{tag}_base")
+            assert rc == 0, out[-3000:]
+            assert rep is not None, f"{tag}: no run_report.json"
+            s = rep["summary"]
+            hists = rep.get("hists") or {}
+            ar = hists.get("bsp.allreduce_s") or {}
+            ck = hists.get("bsp.checkpoint_s") or {}
+            rc2, out2, wall_kill, rep_kill = run_bsp_job(
+                module, app_args, kill, workers=workers, restarts=1,
+                timeout=300, obs_dir=f"{td}/obs_{tag}_kill")
+            assert rc2 == 0, out2[-3000:]
+            nck = max(int(s.get("bsp_checkpoints") or 0), 1)
+            ksum = (rep_kill or {}).get("summary") or {}
+            rows.append((tag, {
+                "allreduce_ms": (ar.get("mean") or 0.0) * 1e3,
+                "allreduce_p99_ms": round((ar.get("p99") or 0.0) * 1e3, 3),
+                "checkpoint_ms": round((ck.get("mean") or 0.0) * 1e3, 3),
+                "checkpoint_bytes": int(s.get("bsp_checkpoint_bytes", 0))
+                // nck,
+                "bsp_rounds": int(s.get("bsp_rounds", 0)),
+                "bsp_checkpoints": int(s.get("bsp_checkpoints", 0)),
+                "wall_s": round(wall, 2),
+                "recovery_overhead_s": round(wall_kill - wall, 2),
+                "kill_recoveries": int(ksum.get("bsp_recoveries", 0)),
+            }))
+    return rows
+
+
+def emit_bsp():
+    got = _safe("bsp", bench_bsp)
+    if got is None:
+        return
+    for tag, r in got:
+        emit(f"{tag}_bsp_dist_3w_allreduce_ms_per_round",
+             r.pop("allreduce_ms"), "ms", **r)
+
+
 def _safe(what, fn, *args, **kw):
     """Failure isolation: one config blowing up must never suppress the
     lines after it — r3 lost its headline to exactly that (the PS bench
@@ -533,6 +606,16 @@ def _safe(what, fn, *args, **kw):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--group", choices=["all", "bsp"], default="all",
+                    help="run one bench group (bsp: the native BSP "
+                         "allreduce stack) instead of the full suite")
+    args = ap.parse_args()
+    if args.group == "bsp":
+        emit_bsp()
+        return
     eps = _safe("difacto", bench_difacto)
     if eps is not None:
         emit("difacto_fm_dim8_criteo_shape_examples_per_sec", eps,
@@ -586,6 +669,7 @@ def main():
              pack_cache_hit_rate=round(hit, 4),
              loader_stall_s=round(stall, 4),
              loader_stall_frac=round(stall / max(wall, 1e-9), 4))
+    emit_bsp()
     # headline LAST: the driver parses the final JSON line. A headline
     # failure must stay LOUD (rc=1) — otherwise the previous line (a
     # different metric in different units) would silently be recorded

@@ -1,7 +1,22 @@
 #!/usr/bin/env python
-"""Fault-injection lab: run a small difacto job under a matrix of
+"""Fault-injection lab: run a small distributed job under a matrix of
 WH_FAULT_SPEC scenarios and classify each run against an unfaulted
 baseline.
+
+Two stacks share the lab:
+
+  --stack ps   (default) the parameter-server plane: a difacto job with
+               server kills, connection resets, and latency; verdicts
+               compare the final logloss and the recovery metrics.
+  --stack bsp  the native BSP allreduce plane (runtime/allreduce.py): a
+               3-process GBDT job and a 3-process L-BFGS job, each run
+               fault-free first and then under worker kills mid-epoch.
+               Because the ring replays collectives bit-for-bit from
+               version checkpoints, the verdict is STRICTER than the ps
+               stack's tolerance check: the recovered model must be
+               BIT-IDENTICAL to the fault-free baseline's, array by
+               array — any drift is SILENT-CORRUPTION. A kill run must
+               also show bsp_recoveries > 0 in its run report.
 
 Three verdicts per scenario:
 
@@ -80,6 +95,32 @@ DEFAULT_SPECS = [
     "net:delay:ms=2",
 ]
 
+# --stack bsp matrix: (job name, app module, key=value argv builder,
+# fault specs). The kill counts are tuned to land mid-epoch: gbdt does 5
+# allreduces per round (4 tree levels + 1 eval metric block), so #6 is
+# the first histogram of round 1, after one checkpoint exists; lbfgs
+# does grad + eval + one eval per line-search trial, so #4 is inside
+# iteration 1. checkpoint:2 dies at the round-1 checkpoint entry —
+# the respawn must resume from the round-0 state.
+BSP_JOBS = [
+    ("gbdt", "wormhole_tpu.apps.gbdt",
+     lambda scratch: [f"train_data={scratch}/train-.*",
+                      f"eval_data={scratch}/val.libsvm",
+                      "bsp=1", "num_round=4", "max_depth=3",
+                      "max_bin=16", "minibatch=256"],
+     ["worker:1:kill@allreduce:6", "worker:0:kill@checkpoint:2",
+      "net:delay:ms=2"]),
+    ("lbfgs", "wormhole_tpu.apps.lbfgs_linear",
+     lambda scratch: [f"data={scratch}/train-.*", "bsp=1",
+                      "max_lbfgs_iter=6", "reg_L2=0.001",
+                      "minibatch=256"],
+     ["worker:1:kill@allreduce:4", "net:delay:ms=2"]),
+]
+
+_BSP_METRIC_KEYS = ("bsp_recoveries", "bsp_ring_retries",
+                    "bsp_result_fetches", "bsp_rounds",
+                    "bsp_checkpoints", "connect_retries")
+
 
 def synth_libsvm(path: str, n_rows: int, seed: int, n_feat: int = 1000,
                  nnz: int = 8, w_seed: int = 1234) -> None:
@@ -148,26 +189,174 @@ _METRIC_KEYS = ("ps_retries", "journal_replays", "replay_dedup_hits",
                 "keycache_invalidations")
 
 
-def report_metrics(report: dict | None) -> dict[str, int]:
+def report_metrics(report: dict | None,
+                   keys: tuple = _METRIC_KEYS) -> dict[str, int]:
     s = (report or {}).get("summary") or {}
-    return {k: int(s.get(k, 0)) for k in _METRIC_KEYS}
+    return {k: int(s.get(k, 0)) for k in keys}
 
 
-def metric_deltas(m: dict[str, int], base: dict[str, int]) -> str:
-    return " ".join(f"Δ{k}={m[k] - base[k]:+d}" for k in _METRIC_KEYS
+def metric_deltas(m: dict[str, int], base: dict[str, int],
+                  keys: tuple = _METRIC_KEYS) -> str:
+    return " ".join(f"Δ{k}={m[k] - base[k]:+d}" for k in keys
                     if m[k] - base[k] != 0) or "Δ(none)"
+
+
+def fault_fired(out: str) -> bool:
+    """Did the injected fault actually trigger? Matches the arm/fire
+    lines of every faults.py family: net injections, server kills, and
+    BSP worker kills."""
+    return bool(re.search(
+        r"\[faults\] (injecting|server rank|worker rank)", out))
+
+
+def models_equal(a_path: str, b_path: str) -> tuple[bool, str]:
+    """Array-level bit-identity of two .npz models. The container bytes
+    are NOT comparable (zip member timestamps differ per run); the
+    arrays must match exactly."""
+    try:
+        a = np.load(a_path, allow_pickle=True)
+        b = np.load(b_path, allow_pickle=True)
+    except OSError as e:
+        return False, f"unreadable model: {e}"
+    if sorted(a.files) != sorted(b.files):
+        return False, f"key sets differ: {a.files} vs {b.files}"
+    for k in a.files:
+        if not np.array_equal(np.asarray(a[k]), np.asarray(b[k])):
+            return False, f"array {k!r} differs"
+    return True, "bit-identical"
+
+
+def run_bsp_job(module: str, app_args: list[str], spec: str,
+                workers: int, restarts: int, timeout: float,
+                obs_dir: str) -> tuple[int, str, float, dict | None]:
+    """One launcher run of a BSP app: `-s 0` (no ps plane) with worker
+    supervision on — the respawned incarnation resumes from its BSP
+    version checkpoint."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("WH_FAULT_SPEC", None)
+    env.pop("WH_OBS_DIR", None)
+    if spec:
+        env["WH_FAULT_SPEC"] = spec
+    os.makedirs(obs_dir, exist_ok=True)
+    env["WH_OBS_DIR"] = obs_dir
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", str(workers), "-s", "0",
+         "--node-timeout", "10",
+         "--max-worker-restarts", str(restarts), "--",
+         sys.executable, "-m", module] + app_args,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    report = None
+    try:
+        with open(os.path.join(obs_dir, "run_report.json")) as fh:
+            report = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass  # a crashed run may not get as far as the report
+    return r.returncode, r.stdout + r.stderr, time.monotonic() - t0, report
+
+
+def bsp_matrix(args) -> int:
+    """The --stack bsp lab: per job, a fault-free baseline model, then
+    each fault scenario must (a) exit clean, (b) reproduce the baseline
+    model BIT-identically, and (c) for kill specs, show the recovery in
+    bsp_recoveries — a clean model with no recovery observed means the
+    kill count never fired or was absorbed by accident."""
+    workers = args.workers or 3
+    restarts = 0 if args.no_recovery else args.restarts
+    scratch = tempfile.mkdtemp(prefix="wh_chaos_bsp_")
+    for i in range(workers):
+        synth_libsvm(os.path.join(scratch, f"train-{i}.libsvm"),
+                     args.rows, seed=i)
+    synth_libsvm(os.path.join(scratch, "val.libsvm"), args.rows, seed=9)
+    print(f"[chaos] stack=bsp scratch={scratch} workers={workers} "
+          f"max_worker_restarts={restarts}")
+
+    rows, worst = [], 0
+    for job, module, argv_fn, default_specs in BSP_JOBS:
+        specs = args.specs if args.specs is not None else default_specs
+        base_model = os.path.join(scratch, f"{job}-baseline.npz")
+        rc, out, dt, base_report = run_bsp_job(
+            module, argv_fn(scratch) + [f"model_out={base_model}"], "",
+            workers, restarts, args.timeout,
+            os.path.join(scratch, f"obs-{job}-baseline"))
+        if rc != 0 or not os.path.exists(base_model):
+            print(out[-4000:])
+            print(f"[chaos] {job} baseline (no fault) FAILED rc={rc} — "
+                  "nothing to compare against; fix the clean path first")
+            return 2
+        base_m = report_metrics(base_report, _BSP_METRIC_KEYS)
+        print(f"[chaos] {job} baseline: ok ({dt:.0f}s) "
+              f"rounds={base_m['bsp_rounds']} "
+              f"checkpoints={base_m['bsp_checkpoints']}")
+
+        for i, spec in enumerate(specs):
+            model = os.path.join(scratch, f"{job}-{i}.npz")
+            rc, out, dt, report = run_bsp_job(
+                module, argv_fn(scratch) + [f"model_out={model}"], spec,
+                workers, restarts, args.timeout,
+                os.path.join(scratch, f"obs-{job}-{i}"))
+            m = report_metrics(report, _BSP_METRIC_KEYS)
+            is_kill = "kill" in spec
+            if rc != 0 or not os.path.exists(model):
+                verdict, detail = "FAILED", f"rc={rc}"
+                worst = max(worst, 1)
+                tail = "\n".join(out.splitlines()[-12:])
+                detail += "\n    " + tail.replace("\n", "\n    ")
+            else:
+                same, why = models_equal(base_model, model)
+                if not same:
+                    verdict, detail = "SILENT-CORRUPTION", why
+                    worst = max(worst, 3)
+                else:
+                    verdict, detail = "survived", why
+                    if is_kill and not fault_fired(out):
+                        verdict = "survived (fault never fired!)"
+                    elif is_kill and report is not None \
+                            and m["bsp_recoveries"] < 1:
+                        verdict = "survived (no recovery observed!)"
+            recov = len(re.findall(r"respawning with restore epoch", out))
+            deltas = metric_deltas(m, base_m, _BSP_METRIC_KEYS) \
+                if report is not None else "(no run_report.json)"
+            rows.append((f"{job}: {spec}", verdict, detail, recov, dt,
+                         deltas))
+            print(f"[chaos] {job}: {spec}: {verdict} "
+                  f"({detail.splitlines()[0]}, {recov} respawns, "
+                  f"{dt:.0f}s)")
+            print(f"[chaos]   metrics vs baseline: {deltas}")
+
+    print(f"\n{'spec':<42} {'verdict':<30} {'respawns':>8} {'sec':>5}")
+    for spec, verdict, detail, recov, dt, deltas in rows:
+        print(f"{spec:<42} {verdict:<30} {recov:>8} {dt:>5.0f}")
+        print(f"    {detail.splitlines()[0]}")
+        print(f"    {deltas}")
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+    return worst if worst != 1 else 1
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="fault-injection matrix for the ps recovery path")
-    ap.add_argument("--specs", nargs="*", default=DEFAULT_SPECS,
+        description="fault-injection matrix for the recovery paths")
+    ap.add_argument("--stack", choices=("ps", "bsp"), default="ps",
+                    help="which recovery plane to exercise: the "
+                         "parameter-server difacto job (ps) or the "
+                         "native BSP allreduce GBDT + L-BFGS jobs (bsp)")
+    ap.add_argument("--specs", nargs="*", default=None,
                     help="WH_FAULT_SPEC values to run (see "
-                         "runtime/faults.py for the grammar)")
-    ap.add_argument("--workers", type=int, default=2)
+                         "runtime/faults.py for the grammar); default: "
+                         "the stack's built-in matrix")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="worker processes (default: 2 for ps, 3 for "
+                         "bsp)")
     ap.add_argument("--servers", type=int, default=2)
     ap.add_argument("--restarts", type=int, default=1,
-                    help="--max-server-restarts for the faulted runs")
+                    help="--max-server-restarts (ps) or "
+                         "--max-worker-restarts (bsp) for the faulted "
+                         "runs")
     ap.add_argument("--sync-mode", action="store_true",
                     help="run with WH_ASYNC_SYNC=0 WH_KEYCACHE=0 (the "
                          "pre-overlap synchronous plane); default is "
@@ -188,6 +377,11 @@ def main(argv=None) -> int:
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir (data + confs)")
     args = ap.parse_args(argv)
+
+    if args.stack == "bsp":
+        return bsp_matrix(args)
+    args.workers = args.workers or 2
+    args.specs = args.specs if args.specs is not None else DEFAULT_SPECS
 
     scratch = tempfile.mkdtemp(prefix="wh_chaos_")
     for i in range(2):
@@ -272,9 +466,7 @@ max_delay = 1
             # a "survival" during which the fault never fired proves
             # nothing — call it out so the spec gets retuned (e.g. a
             # kill/reset count the short job never reaches)
-            if ("kill" in spec or "reset" in spec) \
-                    and not re.search(r"\[faults\] (injecting|server rank)",
-                                      out):
+            if ("kill" in spec or "reset" in spec) and not fault_fired(out):
                 verdict = "survived (fault never fired!)"
             elif report is not None and "kill" in spec and not (
                     m["server_restores"] or m["server_recoveries"]

@@ -95,6 +95,70 @@ def maybe_run_global(cfg, worker_body):
         return worker_body(cfg, env, client)
 
 
+def maybe_run_bsp(cfg, worker_body):
+    """Role dispatch for BSP-allreduce apps (bsp=1 under the launcher):
+    returns an exit code when this process has a distributed role, else
+    None (caller falls through to the single-process path). Each worker
+    gets a `BspWorker` (runtime/allreduce.py) registered with the
+    tracker; `worker_body` is called as worker_body(cfg, env, client,
+    comm). The scheduler runs a liveness-only loop and emits the run
+    report at drain; servers are idle (`-s 0` is the natural launch)."""
+    if not getattr(cfg, "bsp", False):
+        return None
+    env = node_env()
+    if env.role is None:
+        return None
+    if env.role.value == "scheduler":
+        _run_scheduler_bsp(env)
+        return 0
+    if env.role.value == "server":
+        return 0
+    from wormhole_tpu.runtime.allreduce import BspWorker
+    from wormhole_tpu.runtime.tracker import LivenessPinger
+
+    client = SchedulerClient(env.scheduler_uri, f"worker-{env.rank}")
+    client.register()
+    pinger = LivenessPinger(client)
+    comm = BspWorker(env.rank, env.num_workers, client)
+    try:
+        rc = worker_body(cfg, env, client, comm)
+    finally:
+        pinger.stop()
+        comm.close()
+    try:
+        # final metrics snapshot rides the deregistration (same contract
+        # as _run_worker: bye ONLY on clean completion — a crashed
+        # worker must instead be evicted, which is what lets the
+        # launcher's respawn rejoin the group)
+        client.call(op="bye", metrics=_obs.REGISTRY.snapshot())
+    except Exception:
+        pass
+    return rc
+
+
+def _run_scheduler_bsp(env) -> None:
+    """BSP-mode scheduler: liveness + rendezvous (register_bsp/bsp_peers/
+    blobs) — the collectives themselves are worker-to-worker. Exits once
+    every worker registered and left, emitting the aggregated run
+    report; bounded startup so a mis-launched job fails loudly."""
+    sched = Scheduler.from_env(env)
+    sched.serve()
+    startup_deadline = time.monotonic() + max(60.0, sched.node_timeout * 4)
+    try:
+        seen_any = False
+        while True:
+            time.sleep(0.5)
+            seen_any = seen_any or bool(sched.live_workers())
+            if seen_any and sched.workers_drained(env.num_workers):
+                break
+            if not seen_any and time.monotonic() > startup_deadline:
+                raise RuntimeError(
+                    "no BSP worker registered within the startup deadline")
+        _emit_run_report(sched, None, verbose=True)
+    finally:
+        sched.stop()
+
+
 def _run_scheduler_global(env) -> dict:
     """Global-mesh mode scheduler: pure liveness — the SPMD collectives
     synchronize the workers, so the control plane only keeps the launcher

@@ -128,10 +128,161 @@ def _global_worker_body(cfg, env, client) -> int:
     return 0
 
 
+def _bsp_worker_body(cfg, env, client, comm) -> int:
+    """Multi-process GBDT over the native BSP allreduce ring
+    (runtime/allreduce.py) — the literal rabit layout of the reference:
+    each rank keeps its own local mesh and row shard, per-level
+    histogram blocks allreduce over the worker ring, and a version
+    checkpoint after every boosting round makes a killed worker
+    recoverable (the launcher respawns it; it reloads its trees and
+    replays the missed collectives from peers' result caches).
+
+    All pre-training setup (quantile sketch, dim discovery) goes through
+    the scheduler BLOB channel, never the ring: blobs persist, so a
+    respawned worker re-reads identical values while consuming ZERO
+    collective counters — its (version, seq) sequence stays aligned
+    with the survivors'."""
+    import numpy as np
+
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.models.gbdt import (BinnedDataset, Reservoir,
+                                          _densify, _densify_sample,
+                                          _SKETCH_ROWS, bin_matrix,
+                                          quantile_edges)
+    from wormhole_tpu.parallel import multihost as mh
+    from wormhole_tpu.parallel.mesh import batch_sharding
+
+    assert cfg.task == "train", "bsp supports task=train"
+    if cfg.model_in:
+        raise NotImplementedError(
+            "model_in warm start is not supported in bsp mode yet")
+    rank, nproc = env.rank, env.num_workers
+
+    def my_parts(pattern):
+        return mh.rank_parts(pattern, cfg.num_parts_per_file, env)
+
+    # per-rank quantile sketch, merged by rank 0 over the blob channel
+    # (same protocol as the global-mesh path). Deterministic per rank
+    # (seeded reservoir over a stable part slice), so a respawned
+    # worker's re-publish is a no-op overwrite.
+    res = Reservoir(_SKETCH_ROWS // max(nproc, 1), cfg.seed + rank)
+    for f, k in my_parts(cfg.train_data):
+        for blk in MinibatchIter(f, k, cfg.num_parts_per_file,
+                                 cfg.data_format,
+                                 minibatch_size=cfg.minibatch):
+            res.add_block(blk)
+    sidx = (np.concatenate([r[0] for r in res.sample])
+            if res.sample else np.zeros(0, np.uint64))
+    sval = (np.concatenate([r[1] for r in res.sample])
+            if res.sample else np.zeros(0, np.float32))
+    soff = np.zeros(len(res.sample) + 1, np.int64)
+    np.cumsum([len(r[0]) for r in res.sample], out=soff[1:])
+    client.blob_put(f"gbdt_bsp_sketch_{rank}",
+                    {"idx": sidx.astype(np.uint64), "val": sval,
+                     "off": soff, "max_feat": np.int64(res.max_feat)})
+    if rank == 0 and not client.call(op="blob_get",
+                                     key="gbdt_bsp_meta")["ok"]:
+        # merge (first incarnation only: a respawned rank 0 finds the
+        # meta blob already published and must reuse it — and the
+        # sketches are never deleted, for the same reason)
+        rows, max_feat = [], res.max_feat
+        for r in range(nproc):
+            p = client.blob_get(f"gbdt_bsp_sketch_{r}", timeout=120)
+            max_feat = max(max_feat, int(p["max_feat"]))
+            rows.extend((p["idx"][lo:hi], p["val"][lo:hi])
+                        for lo, hi in zip(p["off"], p["off"][1:]))
+        dim = cfg.dim if cfg.dim else max(max_feat + 1, 1)
+        edges = quantile_edges(_densify_sample(rows, dim), cfg.max_bin)
+        client.blob_put("gbdt_bsp_meta",
+                        {"edges": edges, "dim": np.int64(dim)})
+    meta = client.blob_get("gbdt_bsp_meta", timeout=120)
+    cfg.dim = int(meta["dim"])
+    edges = meta["edges"]
+
+    lrn = GbdtLearner(cfg)  # local mesh; the ring spans the ranks
+    lrn.edges = edges
+
+    def load_local(pattern):
+        chunks, labels = [], []
+        for f, k in my_parts(pattern):
+            for blk in MinibatchIter(f, k, cfg.num_parts_per_file,
+                                     cfg.data_format,
+                                     minibatch_size=cfg.minibatch):
+                chunks.append(bin_matrix(_densify(blk, cfg.dim), edges))
+                labels.append(blk.label.astype(np.float32))
+        n = sum(c.shape[0] for c in chunks)
+        # rows pad to the LOCAL data axis only — ranks may hold skewed
+        # (even zero) row counts; the reduced histogram blocks are the
+        # only shapes that must agree, and those depend on (dim,
+        # max_bin, depth) alone
+        n_pad = -(-max(n, 1) // lrn._n_data) * lrn._n_data
+        binned = np.zeros((n_pad, cfg.dim), np.uint8)
+        label = np.zeros(n_pad, np.float32)
+        mask = np.zeros(n_pad, np.float32)
+        if n:
+            binned[:n] = np.concatenate(chunks)
+            label[:n] = np.concatenate(labels)
+            mask[:n] = 1.0
+        import jax
+
+        return BinnedDataset(
+            binned=jax.device_put(binned, batch_sharding(lrn.mesh, 2)),
+            label=jax.device_put(label, batch_sharding(lrn.mesh, 1)),
+            mask=jax.device_put(mask, batch_sharding(lrn.mesh, 1)),
+            num_real=n,
+        )
+
+    train = load_local(cfg.train_data)
+    evals = []
+    if cfg.eval_data:
+        evals.append((cfg.eval_name, load_local(cfg.eval_data)))
+    if cfg.eval_train:
+        evals.append(("train", train))
+    lrn.reducer = comm.allreduce
+
+    # recovery: the launcher's respawn loads the version checkpoint
+    # (round count + trees so far); fit_prepared's warm-start replay
+    # rebuilds the margins locally, then the missed collectives of the
+    # current round come from peers' caches, bit-identical
+    r0 = 0
+    st = comm.load_checkpoint()
+    if st is not None:
+        r0 = int(st["round"])
+        for k in lrn.trees:
+            lrn.trees[k][:r0] = st[k]
+        print(f"[gbdt-bsp] rank {rank} resuming at round {r0} "
+              f"(version {comm.version})", flush=True)
+
+    def on_round(r):
+        # AFTER every collective of round r (histograms + metric sums):
+        # the version bump here is what keeps a resumed worker's
+        # counter sequence aligned with the survivors'
+        comm.checkpoint({"round": np.int64(r + 1),
+                         **{k: v[: r + 1]
+                            for k, v in lrn.trees.items()}})
+
+    if rank != 0:
+        cfg.model_out = None  # single writer
+    last = lrn.fit_prepared(train, evals, r0=r0, verbose=(rank == 0),
+                            on_round=on_round)
+    if rank == 0:
+        for name, m in last.items():
+            print("final " + name + ": "
+                  + " ".join(f"{k}={v:.6f}" for k, v in m.items()),
+                  flush=True)
+        if cfg.model_out:
+            print(f"saved model to {cfg.model_out}", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cfg = parse_cli(GbdtConfig, argv)
-    from wormhole_tpu.apps._runner import maybe_run_global
+    from wormhole_tpu.apps._runner import maybe_run_bsp, maybe_run_global
+
+    rc = maybe_run_bsp(cfg, _bsp_worker_body)
+    if rc is not None:
+        return rc
 
     def body(cfg, env, client):
         assert cfg.task == "train", "global_mesh supports task=train"

@@ -38,11 +38,45 @@ class LbfgsFmConfig:
     nnz_per_row: int = 64
     num_parts_per_file: int = 1
     seed: int = 0
+    # multi-process BSP over the native allreduce ring (parameters
+    # replicated per rank, data partitioned, gradient/loss reduced over
+    # the ring; fault-tolerant via version checkpoints)
+    bsp: bool = False
+
+
+def _bsp_worker_body(cfg, env, client, comm) -> int:
+    from wormhole_tpu.models.batch_objectives import load_batches_bsp
+    from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
+
+    rank = env.rank
+    mesh = make_mesh()
+    batches, num_feature = load_batches_bsp(
+        cfg.data, mesh, env, client, cfg.data_format, cfg.minibatch,
+        cfg.nnz_per_row, cfg.num_parts_per_file, key="lbfgs_fm_dim")
+    obj = FmObjFunction(batches, num_feature, cfg.nfactor, mesh,
+                        init_scale=cfg.init_sigma, seed=cfg.seed)
+    solver = LBFGSSolver(obj, LBFGSConfig(
+        max_iter=cfg.max_lbfgs_iter, m=cfg.m, reg_l1=cfg.reg_L1,
+        reg_l2=cfg.reg_L2, min_rel_decrease=cfg.lbfgs_stop_tol),
+        comm=comm)
+    w, objv = solver.run(verbose=(rank == 0))
+    if rank == 0:
+        if cfg.model_out:
+            np.savez(cfg.model_out, w=np.asarray(w), nfactor=cfg.nfactor,
+                     num_feature=num_feature)
+            print(f"saved model to {cfg.model_out}", flush=True)
+        print(f"final objective: {objv:.6f}", flush=True)
+    return 0
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cfg = parse_cli(LbfgsFmConfig, argv)
+    from wormhole_tpu.apps._runner import maybe_run_bsp
+
+    rc = maybe_run_bsp(cfg, _bsp_worker_body)
+    if rc is not None:
+        return rc
     mesh = make_mesh()
     batches, num_feature = load_batches(
         cfg.data, mesh, cfg.data_format, cfg.minibatch, cfg.nnz_per_row,

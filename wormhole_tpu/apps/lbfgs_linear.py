@@ -48,6 +48,11 @@ class LbfgsLinearConfig:
     # rank partition, lbfgs.h:127-136) and all dot products ride the
     # mesh collectives
     global_mesh: bool = False
+    # multi-process BSP over the native allreduce ring
+    # (runtime/allreduce.py): parameters replicated per rank, data
+    # partitioned, gradient/loss reduced over the ring — the reference's
+    # rabit layout, fault-tolerant via version checkpoints
+    bsp: bool = False
 
 
 def _global_worker_body(cfg, env, client) -> int:
@@ -82,10 +87,46 @@ def _global_worker_body(cfg, env, client) -> int:
     return 0
 
 
+def _bsp_worker_body(cfg, env, client, comm) -> int:
+    """Distributed L-BFGS over the native BSP allreduce ring: this rank
+    loads its part slice, the solver reduces the two data-dependent
+    quantities (gradient, raw loss) over the ring, and every iteration
+    ends in a version checkpoint — a killed worker respawns, reloads
+    (w, g, history, S, Y), and replays the collectives it missed from
+    peers' result caches."""
+    from wormhole_tpu.models.batch_objectives import load_batches_bsp
+
+    assert cfg.task == "train", "bsp supports task=train"
+    rank = env.rank
+    mesh = make_mesh()
+    batches, num_feature = load_batches_bsp(
+        cfg.data, mesh, env, client, cfg.data_format, cfg.minibatch,
+        cfg.nnz_per_row, cfg.num_parts_per_file)
+    obj = LinearObjFunction(batches, num_feature, mesh)
+    solver = LBFGSSolver(obj, LBFGSConfig(
+        max_iter=cfg.max_lbfgs_iter, m=cfg.m, reg_l1=cfg.reg_L1,
+        reg_l2=cfg.reg_L2, min_rel_decrease=cfg.lbfgs_stop_tol),
+        comm=comm)
+    # every rank drives the identical host loop on identical reduced
+    # scalars; w is replicated, so rank 0 alone saves it
+    w, objv = solver.run(verbose=(rank == 0))
+    if rank == 0:
+        if cfg.model_out:
+            np.savez(cfg.model_out, w=np.asarray(w),
+                     num_feature=num_feature)
+            print(f"saved model to {cfg.model_out}", flush=True)
+        print(f"final objective: {objv:.6f}", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cfg = parse_cli(LbfgsLinearConfig, argv)
-    from wormhole_tpu.apps._runner import maybe_run_global
+    from wormhole_tpu.apps._runner import maybe_run_bsp, maybe_run_global
+
+    rc = maybe_run_bsp(cfg, _bsp_worker_body)
+    if rc is not None:
+        return rc
 
     def body(cfg, env, client):
         assert cfg.task == "train", "global_mesh supports task=train"

@@ -263,6 +263,16 @@ declare_knob("WH_KEYCACHE", bool, False,
              "Key-list digest caching on the PS wire (resend on miss).",
              group="ps")
 
+# BSP allreduce plane (runtime/allreduce.py)
+declare_knob("WH_BSP_STEP_TIMEOUT", float, 2.0,
+             "Seconds a BSP worker blocks on one ring step before "
+             "re-polling the tracker for a membership change.",
+             group="bsp")
+declare_knob("WH_BSP_RETRY_SEC", float, 120.0,
+             "Total seconds a blocked BSP collective waits for a dead "
+             "peer's respawn before failing the job.",
+             group="bsp")
+
 # kernel tuning (WORMHOLE_* block-size overrides for Pallas kernels)
 declare_knob("WORMHOLE_TILE_HI", int, 512,
              "Sublanes per tile in the COO kernels.", group="kernel")

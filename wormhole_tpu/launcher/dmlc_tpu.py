@@ -90,6 +90,7 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            scheduler_host: str | None = None,
            coord_port: int = 0,
            max_server_restarts: int = 0,
+           max_worker_restarts: int = 0,
            snapshot_dir: str | None = None,
            pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
                                         "PYTHONPATH")) -> int:
@@ -114,10 +115,19 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     its new URI; workers ride the death out through PSClient's fenced
     retry (WH_PS_RETRY_SEC, exported automatically). Snapshot respawn is
     local-launch only for now (a remote host's respawn would need the
-    ssh round-trip plumbed through the stream threads)."""
+    ssh round-trip plumbed through the stream threads).
+
+    `max_worker_restarts > 0` extends the same supervision to WORKER
+    processes, for the BSP allreduce apps (runtime/allreduce.py): a
+    respawned worker re-registers with the tracker (bumping the group
+    generation), loads its version-stamped checkpoint from
+    `snapshot_dir`, and replays its missed collectives from peers'
+    result caches. Unlike supervised servers, a worker's FINAL exit
+    code always folds into the job's: workers define job success."""
     multi = bool(hosts)
     recovery = max_server_restarts > 0 and num_servers > 0
-    if recovery and snapshot_dir is None:
+    recovery_w = max_worker_restarts > 0 and num_workers > 0
+    if (recovery or recovery_w) and snapshot_dir is None:
         import tempfile
 
         snapshot_dir = tempfile.mkdtemp(prefix="wh_ps_snap_")
@@ -168,6 +178,10 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             # death + respawn + snapshot restore + re-registration; an
             # exported WH_PS_RETRY_SEC (or env_extra below) overrides
             env["WH_PS_RETRY_SEC"] = str(max(120.0, node_timeout * 4))
+        if recovery_w and not os.environ.get("WH_BSP_RETRY_SEC"):
+            # survivor-side stall budget for a blocked BSP collective:
+            # must span a worker death + respawn + checkpoint load
+            env["WH_BSP_RETRY_SEC"] = str(max(120.0, node_timeout * 4))
         if env_extra:
             env.update({k: str(v) for k, v in env_extra.items()})
         return env
@@ -205,10 +219,10 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     role_spawn = spawn_remote if multi else spawn
     sched = spawn("scheduler", 0)  # the tracker node always runs locally
     server_procs = {r: role_spawn("server", r) for r in range(num_servers)}
-    workers = [role_spawn("worker", r) for r in range(num_workers)]
+    worker_procs = {r: role_spawn("worker", r) for r in range(num_workers)}
     procs = {"scheduler": sched}
     procs.update({f"server-{r}": p for r, p in server_procs.items()})
-    procs.update({f"worker-{r}": p for r, p in enumerate(workers)})
+    procs.update({f"worker-{r}": p for r, p in worker_procs.items()})
     threads = []
 
     def scrape_report(line: bytes) -> None:
@@ -246,36 +260,47 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
 
     stop_respawn = threading.Event()
 
-    def respawn_loop(r: int) -> None:
-        """Supervise server rank r: a nonzero/signal exit mid-job gets
-        the process respawned with a bumped WH_RESTORE_EPOCH (snapshot
-        restore), up to the cap."""
+    def respawn_loop(role: str, label: str, r: int, table: dict,
+                     cap: int) -> None:
+        """Supervise one role process: a nonzero/signal exit mid-job gets
+        the process respawned with a bumped WH_RESTORE_EPOCH (snapshot /
+        BSP-checkpoint restore), up to the cap."""
         restarts = 0
         while True:
-            p = server_procs[r]
+            p = table[r]
             code = p.wait()
             if stop_respawn.is_set() or code == 0:
                 return
-            if restarts >= max_server_restarts:
-                print(f"[dmlc_tpu] ERROR: ps server-{r} died again "
-                      f"(exit {code}) and max_server_restarts="
-                      f"{max_server_restarts} is exhausted; not "
+            if restarts >= cap:
+                print(f"[dmlc_tpu] ERROR: {label}-{r} died again "
+                      f"(exit {code}) and max_{role}_restarts="
+                      f"{cap} is exhausted; not "
                       "respawning — the job will fail", flush=True)
                 return
             restarts += 1
-            print(f"[dmlc_tpu] ps server-{r} died (exit {code}); "
+            print(f"[dmlc_tpu] {label}-{r} died (exit {code}); "
                   f"respawning with restore epoch {restarts} "
-                  f"({restarts}/{max_server_restarts})", flush=True)
-            np_ = role_spawn("server", r,
+                  f"({restarts}/{cap})", flush=True)
+            np_ = role_spawn(role, r,
                              {"WH_RESTORE_EPOCH": str(restarts)})
-            server_procs[r] = np_
-            procs[f"server-{r}"] = np_
-            watch_output(f"server-{r}", np_)
+            table[r] = np_
+            procs[f"{role}-{r}"] = np_
+            watch_output(f"{role}-{r}", np_)
 
     monitors = []
     if recovery:
         for r in range(num_servers):
-            m = threading.Thread(target=respawn_loop, args=(r,),
+            m = threading.Thread(target=respawn_loop,
+                                 args=("server", "ps server", r,
+                                       server_procs, max_server_restarts),
+                                 daemon=True)
+            m.start()
+            monitors.append(m)
+    if recovery_w:
+        for r in range(num_workers):
+            m = threading.Thread(target=respawn_loop,
+                                 args=("worker", "worker", r,
+                                       worker_procs, max_worker_restarts),
                                  daemon=True)
             m.start()
             monitors.append(m)
@@ -290,7 +315,11 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             nonlocal rc
             if code != 0 and rc == 0:
                 rc = code if code > 0 else 1
-        for p in workers + list(server_procs.values()):
+        # snapshot CURRENT incarnations (a supervised worker killed
+        # mid-job was replaced in worker_procs by its respawn; the dead
+        # incarnation's 137 is recovery working, not job failure — but
+        # the final incarnation's code always counts)
+        for p in list(worker_procs.values()) + list(server_procs.values()):
             try:
                 code = p.wait(timeout=10)
             except subprocess.TimeoutExpired:
@@ -330,6 +359,11 @@ def main(argv=None) -> int:
                          "rank, restoring its latest shard snapshot "
                          "(0 = no recovery: a server death fails the "
                          "job fast with resume guidance)")
+    ap.add_argument("--max-worker-restarts", type=int, default=0,
+                    help="respawn a dead worker up to N times per rank "
+                         "(BSP allreduce apps recover it from its "
+                         "version checkpoint; 0 = a worker death fails "
+                         "the job)")
     ap.add_argument("--snapshot-dir", default=None,
                     help="directory for the servers' periodic shard "
                          "snapshots (default: a fresh temp dir when "
@@ -377,6 +411,7 @@ def main(argv=None) -> int:
                   scheduler_host=args.scheduler_host,
                   coord_port=args.coord_port,
                   max_server_restarts=args.max_server_restarts,
+                  max_worker_restarts=args.max_worker_restarts,
                   snapshot_dir=args.snapshot_dir)
 
 

@@ -94,6 +94,45 @@ def load_batches_global(pattern: str, mesh, env, fmt: str = "libsvm",
     return out, num_feature
 
 
+def load_batches_bsp(pattern: str, mesh, env, client, fmt: str = "libsvm",
+                     minibatch: int = 4096, nnz_per_row: int = 64,
+                     num_parts_per_file: int = 1, key: str = "lbfgs_dim"):
+    """BSP-allreduce variant of load_batches: each rank loads ITS stable
+    slice of file parts into LOCAL device batches (no jax.distributed —
+    parameters are replicated per rank and the solver reduces gradients
+    and losses over the worker ring instead). The global feature count
+    (the Allreduce<Max> of lbfgs.cc:107-113) is agreed through the
+    scheduler BLOB channel: blobs persist, so a respawned worker
+    re-reads the identical value without consuming a collective counter
+    — its (version, seq) sequence stays aligned with the survivors'."""
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.parallel import multihost as mh
+
+    local, max_id = [], -1
+    for f, k in mh.rank_parts(pattern, num_parts_per_file, env):
+        for blk in MinibatchIter(f, k, num_parts_per_file, fmt,
+                                 minibatch_size=minibatch):
+            if blk.nnz:
+                max_id = max(max_id, int(blk.index.max()))
+            local.append(blk)
+    assert max_id < 2 ** 31 - 1, "batch objectives need int32 ids"
+    client.blob_put(f"{key}_{env.rank}", np.int64(max_id))
+    if env.rank == 0 and not client.call(op="blob_get", key=key)["ok"]:
+        dims = [int(client.blob_get(f"{key}_{r}", timeout=120))
+                for r in range(env.num_workers)]
+        client.blob_put(key, np.int64(max(dims)))
+    num_feature = int(client.blob_get(key, timeout=120)) + 1
+    bsh = batch_sharding(mesh, 1)
+    batches = []
+    for blk in local:  # a zero-part rank simply holds no batches
+        db = to_device_batch(blk, minibatch, minibatch * nnz_per_row,
+                             2 ** 31 - 1)
+        put = lambda x: jax.device_put(x, bsh)
+        batches.append((put(db.seg), put(db.idx), put(db.val),
+                        put(db.label), put(db.row_mask)))
+    return batches, num_feature
+
+
 class _BatchObjBase:
     """Shared accumulate-over-batches eval/grad driver.
 

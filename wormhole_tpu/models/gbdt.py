@@ -40,6 +40,7 @@ from wormhole_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,
     replicated,
+    shard_map,
 )
 from wormhole_tpu.solver.workload import iter_rowblocks
 
@@ -75,6 +76,12 @@ class GbdtConfig:
     # multi-process SPMD over one jax.distributed mesh (apps/gbdt.py
     # _global_worker_body; the reference's rabit world)
     global_mesh: bool = False
+    # multi-process BSP over the native allreduce ring (apps/gbdt.py
+    # _bsp_worker_body over runtime/allreduce.py): each rank keeps its
+    # own local mesh and row shard; per-level histograms reduce over
+    # the worker ring — the literal rabit::Allreduce of histograms,
+    # fault-tolerant via version checkpoints
+    bsp: bool = False
     # TPU-native knobs
     max_bin: int = 256
     dim: int = 0        # feature count; 0 = discover from data
@@ -233,6 +240,12 @@ class GbdtLearner:
         self.trees: dict[str, np.ndarray] = _empty_trees(cfg)
         self._level_fns: dict = {}
         self._jit_cache: dict = {}
+        # optional host allreduce over the worker ring (BSP mode): a
+        # callable f(np.ndarray) -> np.ndarray summing over all ranks.
+        # When set, fit_prepared reduces every level's histogram block
+        # and the eval metric sums through it instead of assuming the
+        # local mesh holds all the data.
+        self.reducer = None
 
     # -- data ---------------------------------------------------------------
     def load_dataset(self, pattern: str, fit_bins: bool = False) -> BinnedDataset:
@@ -307,11 +320,18 @@ class GbdtLearner:
         return (c.dim, c.max_bin, c.max_depth, c.reg_lambda, c.gamma,
                 c.min_child_weight, c.eta, c.objective, c.hist_kernel)
 
-    def _level_fn(self, num_nodes: int, offset: int, last: bool):
-        key = (num_nodes, offset, last, self._hyper_key())
-        fn = self._level_fns.get(key)
-        if fn is not None:
-            return fn
+    def _level_parts(self, num_nodes: int, offset: int, last: bool):
+        """Two traceable halves of one tree level.
+
+        `hist_part` produces the level's stacked [G, H] statistics block
+        (already psum'd over the LOCAL data axis) and `apply_part`
+        consumes such a block to subtract siblings, score splits, and
+        route rows. The single-process/global-mesh path composes them
+        inside one jit (`_level_fn`), where the local psum already spans
+        all the data; the BSP path jits them separately
+        (`_bsp_level_fns`) and host-allreduces the block over the worker
+        ring in between — the literal rabit::Allreduce of gradient
+        histograms."""
         cfg = self.cfg
         F, B = cfg.dim, cfg.max_bin
         lam, gam, mcw, eta = (cfg.reg_lambda, cfg.gamma,
@@ -359,7 +379,7 @@ class GbdtLearner:
             H = jax.lax.psum(H, DATA_AXIS)
             return G, H
 
-        hist = jax.shard_map(
+        hist = shard_map(
             local_hist, mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS)),
@@ -380,15 +400,18 @@ class GbdtLearner:
             return (jax.lax.psum(Gt, DATA_AXIS),
                     jax.lax.psum(Ht, DATA_AXIS))
 
-        totals = jax.shard_map(
+        totals = shard_map(
             local_totals, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=(P(), P()),
             check_vma=False,
         )
 
-        @jax.jit
-        def level_step(binned, g, h, node, active, trees, Gp, Hp):
+        def hist_part(binned, g, h, node, active):
+            """Local [2, ...] stacked G/H statistics for this level —
+            the unit the BSP ring sums. Shape depends only on
+            (num_nodes, F, B), never on the local row count, so every
+            rank's block lines up regardless of data skew."""
             rel = jnp.where(active, node - offset, num_nodes).astype(jnp.int32)
             if sibling:
                 # accumulate left children only (even rel -> pair id)
@@ -397,25 +420,37 @@ class GbdtLearner:
                 if last:
                     # leaf-only level: totals suffice (see local_totals)
                     Gt_l, Ht_l = totals(g, h, relh)
-                    Gt_p = Gp[:, 0, :].sum(-1)
-                    Ht_p = Hp[:, 0, :].sum(-1)
-                    Gt = jnp.stack([Gt_l, Gt_p - Gt_l], 1).reshape(
-                        num_nodes)
-                    Ht = jnp.stack([Ht_l, Ht_p - Ht_l], 1).reshape(
-                        num_nodes)
-                    leaf = -Gt / (Ht + lam) * eta
-                    sl = slice(offset, offset + num_nodes)
-                    trees = dict(trees)
-                    trees["leaf_value"] = trees["leaf_value"].at[sl].set(
-                        leaf)
-                    return node, jnp.zeros_like(active), trees, Gp, Hp
+                    return jnp.stack([Gt_l, Ht_l])     # [2, hist_nodes]
                 Gl, Hl = hist(binned, g, h, relh)
+                return jnp.stack([Gl, Hl])     # [2, hist_nodes, F, B]
+            G, H = hist(binned, g, h, rel)
+            return jnp.stack([G, H])           # [2, num_nodes, F, B]
+
+        def apply_part(stat, binned, node, active, trees, Gp, Hp):
+            """Consume the (globally summed) statistics block: sibling
+            subtraction, split scoring, row routing."""
+            if sibling and last:
+                Gt_l, Ht_l = stat[0], stat[1]
+                Gt_p = Gp[:, 0, :].sum(-1)
+                Ht_p = Hp[:, 0, :].sum(-1)
+                Gt = jnp.stack([Gt_l, Gt_p - Gt_l], 1).reshape(
+                    num_nodes)
+                Ht = jnp.stack([Ht_l, Ht_p - Ht_l], 1).reshape(
+                    num_nodes)
+                leaf = -Gt / (Ht + lam) * eta
+                sl = slice(offset, offset + num_nodes)
+                trees = dict(trees)
+                trees["leaf_value"] = trees["leaf_value"].at[sl].set(
+                    leaf)
+                return node, jnp.zeros_like(active), trees, Gp, Hp
+            if sibling:
+                Gl, Hl = stat[0], stat[1]
                 G = jnp.stack([Gl, Gp - Gl], axis=1).reshape(
                     num_nodes, F, B)
                 H = jnp.stack([Hl, Hp - Hl], axis=1).reshape(
                     num_nodes, F, B)
             else:
-                G, H = hist(binned, g, h, rel)
+                G, H = stat[0], stat[1]
             Gt, Ht = G[:, 0, :].sum(-1), H[:, 0, :].sum(-1)   # node totals
             leaf = -Gt / (Ht + lam) * eta
             sl = slice(offset, offset + num_nodes)
@@ -455,8 +490,33 @@ class GbdtLearner:
                              node)
             return node, splitting, trees, G, H
 
+        return hist_part, apply_part
+
+    def _level_fn(self, num_nodes: int, offset: int, last: bool):
+        key = (num_nodes, offset, last, self._hyper_key())
+        fn = self._level_fns.get(key)
+        if fn is not None:
+            return fn
+        hp, ap = self._level_parts(num_nodes, offset, last)
+
+        @jax.jit
+        def level_step(binned, g, h, node, active, trees, Gp, Hp):
+            return ap(hp(binned, g, h, node, active), binned, node,
+                      active, trees, Gp, Hp)
+
         self._level_fns[key] = level_step
         return level_step
+
+    def _bsp_level_fns(self, num_nodes: int, offset: int, last: bool):
+        """The level's halves jitted SEPARATELY, so the histogram block
+        can hop to the host for the ring allreduce between them (the
+        fused per-round program cannot host-call mid-trace)."""
+        key = ("bsp", num_nodes, offset, last, self._hyper_key())
+        fns = self._level_fns.get(key)
+        if fns is None:
+            hp, ap = self._level_parts(num_nodes, offset, last)
+            fns = self._level_fns[key] = (jax.jit(hp), jax.jit(ap))
+        return fns
 
     # -- boosting -----------------------------------------------------------
     def _fused_round_fn(self):
@@ -511,6 +571,80 @@ class GbdtLearner:
             fns = self._jit_cache[key] = (gh, upd)
         return fns
 
+    def _bsp_round(self, train: BinnedDataset, margin):
+        """One boosting round with the histogram allreduce over the
+        worker ring: grad/hess and each level's halves are jitted device
+        steps; between a level's halves the stacked [G, H] block hops to
+        the host and sums over all ranks through `self.reducer`. The
+        ring fixes its accumulation order, so every rank consumes
+        bit-identical reduced blocks — and therefore grows bit-identical
+        trees, which is what lets a respawned worker's replay converge
+        exactly (tests assert recovered == fault-free model)."""
+        cfg = self.cfg
+        T = 2 ** (cfg.max_depth + 1) - 1
+        gh, upd = self._round_fns()
+        g, h = gh(margin, train.label, train.mask)
+        trees = {
+            "split_feat": jnp.zeros(T, jnp.int32),
+            "split_bin": jnp.zeros(T, jnp.int32),
+            "is_split": jnp.zeros(T, jnp.bool_),
+            "leaf_value": jnp.zeros(T, jnp.float32),
+        }
+        node = jnp.zeros(train.label.shape, jnp.int32)
+        active = train.mask > 0
+        F, B = cfg.dim, cfg.max_bin
+        Gp = jnp.zeros((1, F, B), jnp.float32)
+        Hp = jnp.zeros((1, F, B), jnp.float32)
+        for d in range(cfg.max_depth + 1):
+            num_nodes, offset = 2 ** d, 2 ** d - 1
+            hp, ap = self._bsp_level_fns(num_nodes, offset,
+                                         last=(d == cfg.max_depth))
+            stat = hp(train.binned, g, h, node, active)
+            stat = jnp.asarray(self.reducer(np.asarray(stat)))
+            node, active, trees, Gp, Hp = ap(stat, train.binned, node,
+                                             active, trees, Gp, Hp)
+        margin2 = upd(margin, trees["leaf_value"], node)
+        return trees, node, margin2
+
+    def _metric_sums(self):
+        """Jitted per-shard metric SUM vector — the sum-decomposable
+        form that can ride the same allreduce as the histograms."""
+        key = ("metric_sums", self._hyper_key())
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            if self.cfg.objective == "binary:logistic":
+
+                @jax.jit
+                def sums(margin, label, mask):
+                    pred = (margin > 0).astype(jnp.float32)
+                    err = jnp.sum(mask * jnp.abs(pred - label))
+                    ll = jnp.sum(mask * (label * jax.nn.softplus(-margin)
+                                         + (1.0 - label)
+                                         * jax.nn.softplus(margin)))
+                    return jnp.stack([err, ll, jnp.sum(mask)])
+            else:
+
+                @jax.jit
+                def sums(margin, label, mask):
+                    sq = jnp.sum(mask * (margin - label) ** 2)
+                    return jnp.stack([sq, jnp.sum(mask)])
+
+            fn = self._jit_cache[key] = sums
+        return fn
+
+    def _metrics_reduced(self, margin, ds: BinnedDataset) -> dict:
+        """Distributed eval metrics: reduce per-rank sum vectors over
+        the ring, finish the division on the host. AUC is skipped in
+        BSP mode — it needs a global rank ordering of predictions and
+        is not sum-decomposable over row shards."""
+        s = self.reducer(
+            np.asarray(self._metric_sums()(margin, ds.label, ds.mask)))
+        if self.cfg.objective == "binary:logistic":
+            n = max(float(s[2]), 1.0)
+            return {"error": float(s[0]) / n, "logloss": float(s[1]) / n}
+        n = max(float(s[1]), 1.0)
+        return {"rmse": float(np.sqrt(float(s[0]) / n))}
+
     def _base_margins(self, ds: BinnedDataset):
         m = jnp.full(ds.label.shape, self._base_margin(), jnp.float32)
         return jax.device_put(m, batch_sharding(self.mesh, 1))
@@ -536,11 +670,18 @@ class GbdtLearner:
         return self.fit_prepared(train, evals, r0=r0, verbose=verbose)
 
     def fit_prepared(self, train: BinnedDataset, evals, r0: int = 0,
-                     verbose: bool = True) -> dict:
+                     verbose: bool = True, on_round=None) -> dict:
         """The boosting loop over already-loaded datasets — the entry the
         multi-process global-mesh app uses after assembling globally
         sharded datasets (every process must call this in lockstep: each
-        round's histogram/split/metric steps are collectives)."""
+        round's histogram/split/metric steps are collectives). With
+        `self.reducer` set (BSP mode) the per-level blocks and metric
+        sums instead reduce over the worker ring; `on_round(r)` fires
+        after round r's trees and metrics land — the BSP app's
+        checkpoint hook (its placement matters: every collective of
+        round r must complete BEFORE the checkpoint bumps the version,
+        so a resumed worker's counter sequence lines up with the
+        survivors')."""
         cfg = self.cfg
         prior = self.trees
         self.trees = _empty_trees(cfg)
@@ -558,10 +699,13 @@ class GbdtLearner:
                     margins[name] = upd(margins[name], tree["leaf_value"],
                                         self._route(ds, tree))
         last = {}
-        round_fn = self._fused_round_fn()
+        round_fn = self._fused_round_fn() if self.reducer is None else None
         for r in range(r0, cfg.num_round):
-            tree, node, margin = round_fn(train.binned, train.label,
-                                          train.mask, margin)
+            if self.reducer is not None:
+                tree, node, margin = self._bsp_round(train, margin)
+            else:
+                tree, node, margin = round_fn(train.binned, train.label,
+                                              train.mask, margin)
             if os.environ.get("WORMHOLE_DEBUG", "") not in ("", "0"):
                 validate_routing(tree, node)
             for k in self.trees:
@@ -574,10 +718,14 @@ class GbdtLearner:
                     em = margins[name] = upd(
                         margins[name], tree["leaf_value"],
                         self._route(ds, tree))
-                last[name] = m = self._metrics(em, ds)
+                last[name] = m = (self._metrics_reduced(em, ds)
+                                  if self.reducer is not None
+                                  else self._metrics(em, ds))
                 msgs += [f"{name}-{k}:{v:.6f}" for k, v in m.items()]
             if verbose:
                 print(f"[{r}]\t" + "\t".join(msgs), flush=True)
+            if on_round is not None:
+                on_round(r)
             if cfg.save_period and cfg.model_out and (r + 1) % cfg.save_period == 0:
                 self.save(f"{cfg.model_out}.{r + 1:04d}", rounds=r + 1)
         if cfg.model_out:

@@ -36,6 +36,12 @@ COUNTERS: dict[str, str] = {
     "ps.keycache.invalidations": "key caches dropped on restore/reconnect",
     "sched.liveness_evictions": "nodes evicted by the liveness loop",
     "sched.server_recoveries": "server re-registrations after death",
+    "bsp.rounds": "BSP collective rounds completed (allreduce+broadcast)",
+    "bsp.recoveries": "BSP worker re-registrations after death",
+    "bsp.ring_retries": "ring rounds aborted and replayed on a gen bump",
+    "bsp.result_fetches": "cached reduced results served to peers",
+    "bsp.checkpoints": "BSP version checkpoints written",
+    "bsp.checkpoint_bytes": "bytes written by BSP checkpoints",
     "net.frames_sent": "frames written to sockets",
     "net.frames_recv": "frames read from sockets",
     "net.bytes_sent": "bytes written to sockets",
@@ -68,6 +74,8 @@ HISTOGRAMS: dict[str, str] = {
     "ps.client.sync_pull_s": "pull half of a sync round",
     "ps.client.sync_wait_s": "train-thread wait for the async comms thread",
     "sched.barrier_wait_s": "scheduler-side barrier hold time",
+    "bsp.allreduce_s": "one BSP allreduce round, wall time",
+    "bsp.checkpoint_s": "one BSP checkpoint (write + cache prune)",
     "sched.op.*_s": "per-op scheduler handler duration",
     "net.encode_s": "wire message encode duration",
     "net.decode_s": "wire message decode duration",
@@ -93,6 +101,7 @@ EVENTS: dict[str, str] = {
     "ps.rollback": "client detected server epoch rollback",
     "ps.reconnect": "client reconnected to a respawned server",
     "sched.server_recovered": "scheduler accepted a server re-registration",
+    "sched.bsp_recovered": "scheduler accepted a BSP worker re-registration",
     "sched.liveness_evict": "scheduler evicted an unresponsive node",
 }
 # fmt: on

@@ -72,6 +72,12 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "keycache_hits": c.get("ps.keycache.hits", 0),
         "keycache_misses": c.get("ps.keycache.misses", 0),
         "keycache_invalidations": c.get("ps.keycache.invalidations", 0),
+        "bsp_rounds": c.get("bsp.rounds", 0),
+        "bsp_recoveries": c.get("bsp.recoveries", 0),
+        "bsp_ring_retries": c.get("bsp.ring_retries", 0),
+        "bsp_result_fetches": c.get("bsp.result_fetches", 0),
+        "bsp_checkpoints": c.get("bsp.checkpoints", 0),
+        "bsp_checkpoint_bytes": c.get("bsp.checkpoint_bytes", 0),
     }
     report = {
         "run_id": run_id or os.environ.get("WH_RUN_ID"),
@@ -144,6 +150,14 @@ def format_lines(report: dict) -> list[str]:
         f"server_recoveries={s['server_recoveries']} "
         f"restores={s['server_restores']} "
         f"evictions={s['liveness_evictions']}")
+    if s.get("bsp_rounds") or s.get("bsp_recoveries"):
+        lines.append(
+            f"  bsp: rounds={s['bsp_rounds']} "
+            f"checkpoints={s['bsp_checkpoints']} "
+            f"({s['bsp_checkpoint_bytes']}B) "
+            f"recoveries={s['bsp_recoveries']} "
+            f"ring_retries={s['bsp_ring_retries']} "
+            f"result_fetches={s['bsp_result_fetches']}")
     if s.get("keycache_hits") or s.get("keycache_misses") \
             or s.get("keycache_invalidations"):
         lines.append(

@@ -44,6 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental.pallas import tpu as pltpu
 
+from wormhole_tpu.ops.pallas_compat import CompilerParams
+
 import os
 
 # Tile geometry. The per-block cost is dominated by materializing the
@@ -330,7 +332,7 @@ def coo_spmv(w, sidx, sseg, sval, tmap, first, num_rows: int, dtype=None):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_rows // LANES, LANES),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap, first, w, sidx, sseg, sval)
@@ -393,7 +395,7 @@ def coo_spmv_t(d, sidx, sseg, sval, tmap, first, num_buckets: int,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_buckets // LANES, LANES),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap, first, d2, sidx, sseg, sval)
@@ -608,7 +610,7 @@ def tile_gather(table2, uniq, tmap_u, dtype=None):
         partial(_tile_gather_kernel, dtype=dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((u_cap,), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap_u, table2, uniq)
@@ -697,7 +699,7 @@ def fm_push_contrib(V, a, b, sidx, tmap, first, dtype=None):
         partial(_fm_push_contrib_kernel, dim=dim, dtype=dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, dim), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_FM_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(tmap, first, V, ab, sidx)
@@ -789,7 +791,7 @@ def mesh_coo_spmv(mesh, w, sidx, sseg, sval, tmap, first,
     model axis is the ZPull collective."""
     from jax.sharding import PartitionSpec as P
 
-    from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
     D = mesh.shape[DATA_AXIS]
 
@@ -799,7 +801,7 @@ def mesh_coo_spmv(mesh, w, sidx, sseg, sval, tmap, first,
         return jax.lax.psum(xw, MODEL_AXIS)
 
     coo_spec = P(DATA_AXIS, MODEL_AXIS, None)
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(MODEL_AXIS), coo_spec, coo_spec, coo_spec,
                   coo_spec, coo_spec),
@@ -815,7 +817,7 @@ def mesh_coo_spmv_t(mesh, d, sidx, sseg, sval, tmap, first,
     data axis is the ZPush reduce."""
     from jax.sharding import PartitionSpec as P
 
-    from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
     M = mesh.shape[MODEL_AXIS]
 
@@ -825,7 +827,7 @@ def mesh_coo_spmv_t(mesh, d, sidx, sseg, sval, tmap, first,
         return jax.lax.psum(g, DATA_AXIS)
 
     coo_spec = P(DATA_AXIS, MODEL_AXIS, None)
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(DATA_AXIS), coo_spec, coo_spec, coo_spec,
                   coo_spec, coo_spec),

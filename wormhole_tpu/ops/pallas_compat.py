@@ -1,0 +1,11 @@
+"""Pallas-TPU API drift shims: the kernels target the current names and
+this module maps them onto whatever the installed jax provides, so the
+same kernel source runs on jax 0.4.x and >= 0.5."""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as _pltpu
+
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+CompilerParams = getattr(_pltpu, "CompilerParams", None) \
+    or _pltpu.TPUCompilerParams

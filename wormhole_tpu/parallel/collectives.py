@@ -65,7 +65,7 @@ class Communicator:
     def _sum_fn(self, ndim: int):
         fn = self._sum_fns.get(ndim)
         if fn is None:
-            from jax import shard_map
+            from wormhole_tpu.parallel.mesh import shard_map
 
             spec = P(self.axis, *([None] * (ndim - 1)))
 

@@ -25,6 +25,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # 0.4.x keeps it in experimental, as check_rep
+    from functools import wraps
+
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    @wraps(_shard_map_04)
+    def shard_map(f, *, check_vma: Optional[bool] = None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map_04(f, **kw)
+
 
 def make_mesh(
     num_data: Optional[int] = None,

@@ -7,6 +7,8 @@ plane exposes a hook that consults this module:
 - `net.send_frame` / `net.recv_frame` call `ACTIVE.frame(op)` /
   `ACTIVE.recv()` (worker-side network faults),
 - `ServerNode._dispatch` calls `ACTIVE.server_op(op)` (server crashes),
+- `BspWorker` collectives call `ACTIVE.worker_op(op)` (BSP worker
+  crashes mid-round),
 - `Scheduler._dispatch` calls `ACTIVE.sched_op(op)` (control-plane
   faults).
 
@@ -24,6 +26,11 @@ Spec grammar (comma-separated specs; all counters are deterministic):
         FIRST incarnation (WH_RESTORE_EPOCH unset/0) so a respawned
         server survives; ':always' re-arms it in every incarnation
         (respawn-cap exhaustion tests).
+    worker:<rank>:kill@<op>:<nth>[:always]
+        same, for BSP worker processes: <op> is a collective entry
+        point of runtime/allreduce.py ('allreduce', 'broadcast',
+        'checkpoint', or 'any'), so a worker can be killed
+        deterministically mid-round to exercise ring recovery.
     net:reset:after_frames=<N>
         after N request frames have been sent, the next send raises
         ConnectionResetError (fires once). Arms in worker/role-less
@@ -89,9 +96,11 @@ class Faults:
         self._lock = threading.Lock()
         self._frames = 0
         self._op_counts: dict[str, int] = {}
+        self._wop_counts: dict[str, int] = {}
         self._sched_counts: dict[str, int] = {}
         # armed faults
         self._kills: list[tuple[str, int]] = []   # (op, nth)
+        self._wkills: list[tuple[str, int]] = []  # (op, nth) worker kills
         self._delay_s = 0.0
         self._reset_after: Optional[int] = None
         self._drops: list[tuple[str, int]] = []   # (op, nth)
@@ -111,6 +120,16 @@ class Faults:
                 if (role == "server" and self.rank == want_rank
                         and (always or self.epoch == 0)):
                     self._kills.append((op, nth))
+            elif f[0] == "worker":
+                if len(f) < 3:
+                    raise FaultSpecError(
+                        f"bad worker fault {s!r}: expected "
+                        "'worker:<rank>:kill@<op>:<nth>[:always]'")
+                want_rank = int(f[1])
+                op, nth, always = _parse_at(":".join(f[2:]), "kill")
+                if (role == "worker" and self.rank == want_rank
+                        and (always or self.epoch == 0)):
+                    self._wkills.append((op, nth))
             elif f[0] == "net":
                 if len(f) != 3:
                     raise FaultSpecError(f"bad net fault {s!r}")
@@ -169,6 +188,21 @@ class Faults:
             n = n_any if want == "any" else (n_op if want == op else 0)
             if n == nth:
                 print(f"[faults] server rank {self.rank} killing itself at "
+                      f"{want!r} #{nth} (epoch {self.epoch})", flush=True)
+                self.kill_fn(KILL_EXIT)
+
+    def worker_op(self, op) -> None:
+        """At every BSP collective entry; may hard-exit the process."""
+        if not self._wkills:
+            return
+        with self._lock:
+            self._wop_counts[op] = self._wop_counts.get(op, 0) + 1
+            n_op = self._wop_counts[op]
+            n_any = sum(self._wop_counts.values())
+        for want, nth in self._wkills:
+            n = n_any if want == "any" else (n_op if want == op else 0)
+            if n == nth:
+                print(f"[faults] worker rank {self.rank} killing itself at "
                       f"{want!r} #{nth} (epoch {self.epoch})", flush=True)
                 self.kill_fn(KILL_EXIT)
 

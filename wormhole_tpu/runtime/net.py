@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import random
 import socket
 import struct
 import time
@@ -61,7 +62,7 @@ _DECODE_S = _obs.REGISTRY.histogram("net.decode_s")
 def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
                        timeout: float = 60.0) -> socket.socket:
     """Dial `addr`, retrying refused/unreachable connections with
-    exponential backoff until `deadline_s` elapses."""
+    jittered exponential backoff until `deadline_s` elapses."""
     deadline = time.monotonic() + deadline_s
     backoff = 0.05
     while True:
@@ -77,7 +78,10 @@ def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
             _CONNECT_RETRIES.inc()
             if time.monotonic() >= deadline:
                 raise
-            time.sleep(backoff)
+            # jittered backoff: a respawned server/worker is dialed by
+            # every peer at once, and synchronized retries would keep
+            # arriving as a thundering herd on the fresh listen socket
+            time.sleep(backoff * (0.5 + random.random()))
             backoff = min(backoff * 2, 1.0)
 
 

@@ -42,6 +42,7 @@ from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
 
 _EVICTIONS = _obs.REGISTRY.counter("sched.liveness_evictions")
 _SRV_RECOVERIES = _obs.REGISTRY.counter("sched.server_recoveries")
+_BSP_RECOVERIES = _obs.REGISTRY.counter("bsp.recoveries")
 _BARRIER_WAIT_S = _obs.REGISTRY.histogram("sched.barrier_wait_s")
 
 
@@ -113,6 +114,9 @@ class Scheduler:
         self.node_timeout = node_timeout
         self.num_servers = num_servers
         self._server_uris: dict[int, str] = {}   # ps server rank -> uri
+        self._bsp_uris: dict[int, str] = {}      # bsp worker rank -> uri
+        self._bsp_gen = 0                        # membership generation
+        self.num_bsp_recoveries = 0              # workers that re-registered
         self._lock = threading.Lock()
         self._nodes: dict[str, float] = {}       # node -> last seen
         self._barriers: dict[str, set] = {}      # name -> arrived nodes
@@ -326,6 +330,41 @@ class Scheduler:
                 print(f"[recovery] ps server-{rank} re-registered at "
                       f"{req['uri']} (was {prev})", flush=True)
             return {"ok": True}
+        if op == "register_bsp":
+            # a BSP worker announces its ring endpoint. A rank
+            # re-registering under a NEW uri is a respawned worker
+            # rejoining: bump the membership GENERATION — the signal
+            # survivors blocked mid-round poll for (runtime/allreduce.py
+            # aborts and replays the round at the new generation).
+            with self._lock:
+                rank = int(req["rank"])
+                prev = self._bsp_uris.get(rank)
+                self._bsp_uris[rank] = req["uri"]
+                recovered = prev is not None and prev != req["uri"]
+                if recovered:
+                    self._bsp_gen += 1
+                    self.num_bsp_recoveries += 1
+                    self.progress.merge({"bsp_recoveries": 1.0})
+                gen = self._bsp_gen
+            if recovered:
+                _BSP_RECOVERIES.inc()
+                _trace.event("sched.bsp_recovered", cat="recovery",
+                             rank=rank, uri=req["uri"], prev=prev)
+                print(f"[recovery] bsp worker-{rank} re-registered at "
+                      f"{req['uri']} (was {prev}); generation -> {gen}",
+                      flush=True)
+            return {"ok": True, "gen": gen}
+        if op == "bsp_peers":
+            # BSP workers poll until the full group is up, and re-poll
+            # mid-round to detect membership changes
+            world = int(req.get("world", self.num_workers))
+            with self._lock:
+                ready = len(self._bsp_uris) >= world
+                uris = [self._bsp_uris[r]
+                        for r in sorted(self._bsp_uris)] if ready else []
+                gen = self._bsp_gen
+            return {"ready": ready, "gen": gen, "uris": uris,
+                    "num_known": len(self._bsp_uris)}
         if op == "servers":
             # workers poll until the full `-s` group is up
             with self._lock:

@@ -63,11 +63,25 @@ class LBFGSConfig:
 
 
 class LBFGSSolver:
-    """Host-driven L-BFGS over device-sharded vectors."""
+    """Host-driven L-BFGS over device-sharded vectors.
 
-    def __init__(self, obj: ObjFunction, cfg: LBFGSConfig):
+    With `comm` set (a runtime/allreduce.py BspWorker), the solver runs
+    the reference's distributed layout: parameters and history are
+    REPLICATED per rank, data is partitioned, and the two data-dependent
+    quantities — the gradient and the raw objective — allreduce over the
+    worker ring (lbfgs.h:235-303,321-356). Every other scalar (Gram
+    matrix, dots, line-search decisions) is computed from those reduced,
+    bit-identical-across-ranks values, so all ranks drive the identical
+    host loop in lockstep. Checkpoints ride the ring's version protocol
+    (rabit CheckPoint parity): the state includes g and the objective
+    history so a resumed worker SKIPS the init grad/eval recompute —
+    which is what keeps its per-version collective counters aligned with
+    the survivors'."""
+
+    def __init__(self, obj: ObjFunction, cfg: LBFGSConfig, comm=None):
         self.obj = obj
         self.cfg = cfg
+        self.comm = comm
         self.S: list[jax.Array] = []   # s_k = w_{k+1} - w_k
         self.Y: list[jax.Array] = []   # y_k = g_{k+1} - g_k
         self.iter = 0
@@ -175,16 +189,41 @@ class LBFGSSolver:
 
     # -- one iteration (UpdateOneIter, lbfgs.h:168-196) ----------------------
     def _eval_full(self, w) -> float:
-        return self._fetch(self._full_obj(w, self.obj.eval(w)))
+        """Full objective at w. The RAW data loss reduces over the ring
+        BEFORE regularization: the reg terms are functions of the
+        replicated w and must be added exactly once, not `world` times
+        (the reference reduces sum_loss the same way, lbfgs.h:321-340)."""
+        raw = self.obj.eval(w)
+        if self.comm is not None:
+            raw = np.float32(self.comm.allreduce(np.float32(raw)))
+        return self._fetch(self._full_obj(w, raw))
+
+    def _grad(self, w):
+        """Gradient of the data loss: local accumulation, then one ring
+        allreduce, re-placed under the objective's sharding (the single
+        Allreduce<Sum> per iteration of lbfgs.h:194)."""
+        g = self.obj.grad(w)
+        if self.comm is not None:
+            g = np.asarray(self.comm.allreduce(np.asarray(g)))
+            place = getattr(self.obj, "place", None)
+            g = place(jnp.asarray(g, jnp.float32)) if place else (
+                jnp.asarray(g, jnp.float32))
+        return g
 
     def run(self, verbose: bool = True) -> tuple[jax.Array, float]:
         cfg = self.cfg
-        w = self._try_resume()
+        w, g, objv = self._try_resume()
         resumed = w is not None
         if not resumed:
             w = self.obj.init_model()
-        g = self.obj.grad(w)
-        objv = self._eval_full(w)
+        # a full (comm/new-format) checkpoint carries g and the
+        # objective history, so the resumed run skips both recomputes —
+        # required in BSP mode for counter alignment, a free speedup
+        # otherwise. Old file checkpoints (no g) just recompute.
+        if g is None:
+            g = self._grad(w)
+        if objv is None:
+            objv = self._eval_full(w)
         if not resumed:  # resumed history already ends with this objv
             self.objv_history.append(objv)
         if verbose:
@@ -192,6 +231,17 @@ class LBFGSSolver:
                   f"objv {objv:.6f}", flush=True)
 
         while self.iter < cfg.max_iter:
+            # convergence is judged from the (checkpointed) history at
+            # the loop TOP, so a worker that died after the final
+            # checkpoint resumes, observes the same convergence fact the
+            # survivors did, and exits instead of ringing alone
+            if len(self.objv_history) >= 2:
+                prev, cur = self.objv_history[-2], self.objv_history[-1]
+                rel = (prev - cur) / max(abs(prev), 1e-12)
+                if 0 <= rel < cfg.min_rel_decrease:
+                    if verbose:
+                        print("lbfgs: converged", flush=True)
+                    break
             pg = self._pseudo_gradient(w, g)
             d_raw, gd_raw = self._direction(pg)
             d = self._fix_dir_sign(d_raw, pg)
@@ -225,7 +275,7 @@ class LBFGSSolver:
                     print("lbfgs: line search failed, stopping", flush=True)
                 break
 
-            g_new = self.obj.grad(w_new)
+            g_new = self._grad(w_new)
             s = w_new - w
             y = (g_new + cfg.reg_l2 * w_new) - (g + cfg.reg_l2 * w)
             if self._fetch(jnp.vdot(s, y)) > 1e-10:
@@ -234,63 +284,76 @@ class LBFGSSolver:
                 if len(self.S) > cfg.m:
                     self.S.pop(0)
                     self.Y.pop(0)
-            rel = (objv - objv_new) / max(abs(objv), 1e-12)
             w, g, objv = w_new, g_new, objv_new
             self.iter += 1
             self.objv_history.append(objv)
             if verbose:
                 print(f"lbfgs iter {self.iter}: objv {objv:.6f} "
                       f"alpha {alpha:.3g}", flush=True)
-            self._checkpoint(w)
-            if 0 <= rel < cfg.min_rel_decrease:
-                if verbose:
-                    print("lbfgs: converged", flush=True)
-                break
+            self._checkpoint(w, g)
         return w, objv
 
     # -- elastic state (rabit CheckPoint parity, lbfgs.h:120,194) -----------
-    def _checkpoint(self, w) -> None:
+    def _state(self, w, g) -> dict:
+        dim = getattr(self.obj, "num_dim_padded", self.obj.num_dim)
+        return dict(
+            w=np.asarray(w),
+            g=np.asarray(g),
+            iter=np.int64(self.iter),
+            objv=np.asarray(self.objv_history, dtype=np.float64),
+            S=np.stack([np.asarray(s) for s in self.S])
+            if self.S else np.zeros((0, dim)),
+            Y=np.stack([np.asarray(y) for y in self.Y])
+            if self.Y else np.zeros((0, dim)),
+        )
+
+    def _checkpoint(self, w, g) -> None:
+        if self.comm is not None:
+            # version-stamped ring checkpoint: bumps (version, seq) on
+            # every rank in lockstep and persists under the launcher's
+            # snapshot dir for the respawned incarnation
+            self.comm.checkpoint(self._state(w, g))
+            return
         cdir = self.cfg.checkpoint_dir
         if not cdir:
             return
         from wormhole_tpu.utils.checkpoint import atomic_savez
 
         os.makedirs(cdir, exist_ok=True)
-        atomic_savez(
-            os.path.join(cdir, "lbfgs_state.npz"),
-            w=np.asarray(w),
-            iter=self.iter,
-            objv=np.asarray(self.objv_history, dtype=np.float64),
-            S=np.stack([np.asarray(s) for s in self.S])
-            if self.S else np.zeros((0, getattr(self.obj, "num_dim_padded",
-                                                self.obj.num_dim))),
-            Y=np.stack([np.asarray(y) for y in self.Y])
-            if self.Y else np.zeros((0, getattr(self.obj, "num_dim_padded",
-                                                self.obj.num_dim))),
-        )
+        atomic_savez(os.path.join(cdir, "lbfgs_state.npz"),
+                     **self._state(w, g))
+
+    def _restore_vec(self, v):
+        """Re-place a checkpointed vector under the CURRENT objective:
+        strip any old sharding padding (padding is provably zero) and
+        let place() re-pad and shard for this mesh, so a checkpoint
+        moves between device counts and resumed state keeps the
+        non-replicated sharding."""
+        v = np.asarray(v)[: self.obj.num_dim]
+        place = getattr(self.obj, "place", None)
+        return place(jnp.asarray(v, jnp.float32)) if place else (
+            jnp.asarray(v, jnp.float32))
 
     def _try_resume(self):
-        cdir = self.cfg.checkpoint_dir
-        if not cdir:
-            return None
-        path = os.path.join(cdir, "lbfgs_state.npz")
-        if not os.path.exists(path):
-            return None
-        st = np.load(path)
+        """Returns (w, g, objv) — g/objv None when the checkpoint
+        predates them (old file format) and must be recomputed."""
+        if self.comm is not None:
+            st = self.comm.load_checkpoint()
+        else:
+            cdir = self.cfg.checkpoint_dir
+            if not cdir:
+                return None, None, None
+            path = os.path.join(cdir, "lbfgs_state.npz")
+            if not os.path.exists(path):
+                return None, None, None
+            st = dict(np.load(path))
+        if st is None:
+            return None, None, None
         self.iter = int(st["iter"])
         self.objv_history = list(st["objv"])
-
-        def restore(v):
-            """Re-place a checkpointed vector under the CURRENT objective:
-            strip any old sharding padding (padding is provably zero) and
-            let place() re-pad and shard for this mesh, so a checkpoint
-            moves between device counts and resumed state keeps the
-            non-replicated sharding."""
-            v = np.asarray(v)[: self.obj.num_dim]
-            place = getattr(self.obj, "place", None)
-            return place(jnp.asarray(v, jnp.float32)) if place else (
-                jnp.asarray(v, jnp.float32))
-
-        self.S = [restore(s) for s in st["S"]]
-        self.Y = [restore(y) for y in st["Y"]]
-        return restore(st["w"])
+        self.S = [self._restore_vec(s) for s in st["S"]]
+        self.Y = [self._restore_vec(y) for y in st["Y"]]
+        g = self._restore_vec(st["g"]) if "g" in st else None
+        objv = self.objv_history[-1] if (
+            "g" in st and self.objv_history) else None
+        return self._restore_vec(st["w"]), g, objv
