@@ -373,16 +373,23 @@ print_sec = 3600
         # Two runs: the production operating point (async overlapped
         # sync + key caching) and the plain synchronous plane, so the
         # row shows the overlap/caching gain, not just one number.
-        def run_dist(tag, async_sync):
+        def run_dist(tag, async_sync, plane="tcp", extra_argv=()):
             obs_dir = f"{td}/obs_dist_{tag}"
             flag = "1" if async_sync else "0"
+            ev = {"WH_OBS_DIR": obs_dir, "WH_ASYNC_SYNC": flag,
+                  "WH_KEYCACHE": flag, "WH_PS_PLANE": plane}
+            if plane == "hot":
+                # the worker needs a real >= 2 device mesh; must land
+                # before its jax import, hence via the environment
+                ev["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=4").strip()
             r = run_group(
                 [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
                  "-n", "1", "-s", "1", "--",
-                 sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
-                timeout=600, extra_env={"WH_OBS_DIR": obs_dir,
-                                        "WH_ASYNC_SYNC": flag,
-                                        "WH_KEYCACHE": flag})
+                 sys.executable, "-m", "wormhole_tpu.apps.linear", confp,
+                 *extra_argv],
+                timeout=600, extra_env=ev)
             assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
             m = re.search(r"\[ps-wire\] (\{.*\})", r.stdout)
             assert m, r.stdout[-2000:]
@@ -390,19 +397,29 @@ print_sec = 3600
             eps = w["last_round_nex"] / max(w["last_round_sec"], 1e-9)
             return w, eps, obs_dir
 
+        def grab_obs(obs_dir, keys):
+            try:
+                with open(f"{obs_dir}/run_report.json") as fh:
+                    s = json.load(fh)["summary"]
+                return {k: s.get(k) for k in keys}
+            except (OSError, KeyError, json.JSONDecodeError):
+                return None  # telemetry must not fail the bench
+
         wire, dist_eps, obs_dir = run_dist("async", True)
         wire_off, dist_eps_off, _ = run_dist("sync", False)
-        obs = None
-        try:
-            with open(f"{obs_dir}/run_report.json") as fh:
-                s = json.load(fh)["summary"]
-            obs = {k: s.get(k) for k in (
-                "num_push", "num_pull", "bytes_pushed", "bytes_pulled",
-                "net_bytes_sent", "net_bytes_recv",
-                "rpc_p50_ms", "rpc_p99_ms",
-                "keycache_hits", "keycache_misses")}
-        except (OSError, KeyError, json.JSONDecodeError):
-            pass  # telemetry riding along must not fail the bench
+        # the hot plane at the same operating point: tables sharded over
+        # the forced 4-device host mesh, TCP tier at flush barriers only
+        wire_hot, hot_eps, obs_dir_hot = run_dist(
+            "hot", True, plane="hot", extra_argv=("model_shards=2",))
+        obs = grab_obs(obs_dir, (
+            "num_push", "num_pull", "bytes_pushed", "bytes_pulled",
+            "net_bytes_sent", "net_bytes_recv",
+            "rpc_p50_ms", "rpc_p99_ms",
+            "keycache_hits", "keycache_misses"))
+        obs_hot = grab_obs(obs_dir_hot, (
+            "num_push", "num_pull", "bytes_pushed", "bytes_pulled",
+            "net_bytes_sent", "net_bytes_recv",
+            "hot_plane_steps", "hot_plane_flushes"))
 
         r1 = run_group(
             [sys.executable, "-m", "wormhole_tpu.apps.linear", confp],
@@ -416,7 +433,7 @@ print_sec = 3600
     # dense wire at this operating point: push z+n deltas, pull w+z+n
     dense_bytes = 5 * num_buckets * 4
     return dist_eps, dist_eps_off, single_eps, wire, wire_off, \
-        dense_bytes, obs
+        dense_bytes, obs, hot_eps, wire_hot, obs_hot
 
 
 # ---------------------------------------------------------------- kmeans
@@ -637,7 +654,7 @@ def main():
     got = _safe("linear_ps", bench_linear_ps)
     if got is not None:
         (dist_eps, dist_eps_off, single_eps, wire, wire_off,
-         dense_bytes, obs) = got
+         dense_bytes, obs, hot_eps, wire_hot, obs_hot) = got
         # vs_baseline here = ratio to the single-process run on the same
         # data/platform; the recorded run is the production operating
         # point (WH_ASYNC_SYNC=1 WH_KEYCACHE=1), async_off_eps the plain
@@ -661,6 +678,20 @@ def main():
              epoch2_bytes_per_sync_nocache=kc_off,
              keycache_saving_frac=round(1.0 - kc_on / kc_off, 4)
              if kc_off else None)
+        # the hot plane at the same table scale and data: device-resident
+        # sharded tables, TCP tier demoted to flush barriers.
+        # vs_baseline = speedup over the TCP dist row (the ~170x gap this
+        # plane exists to close); single_chip_eps anchors the ceiling
+        emit("linear_ftrl_ps_hot_64m_buckets_examples_per_sec", hot_eps,
+             "examples/sec", hot_eps / dist_eps,
+             plane=wire_hot.get("plane"), workers=1, servers=1,
+             devices=wire_hot.get("devices"),
+             model_shards=2,
+             cold_flushes=wire_hot.get("flushes"),
+             hot_steps=wire_hot.get("hot_steps"),
+             tcp_dist_eps=round(dist_eps, 1),
+             single_chip_eps=round(single_eps, 1),
+             obs=obs_hot)
     got = _safe("linear_epoch2", bench_linear_epoch2, NUM_BUCKETS, MINIBATCH)
     if got is not None:
         eps, stall, wall, hit = got

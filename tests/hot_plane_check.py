@@ -1,0 +1,145 @@
+"""Subprocess body for tests/test_hot_plane.py (not pytest-collected).
+
+Runs under a forced multi-device CPU topology
+(XLA_FLAGS=--xla_force_host_platform_device_count=4, set by the driver
+BEFORE jax imports — which is why this is a subprocess and not a plain
+test): trains one learner plain and one under the hot parameter plane
+(in-process two-shard TCP cold tier) on the identical batch stream and
+asserts
+
+1. bit-identity of the final device tables — the hot plane must never
+   write the device store after init, so both runs execute the exact
+   same jitted programs on the exact same mesh;
+2. the cold tier mirrors the device state after the final flush barrier
+   (allclose: the server accumulates f32 base+delta arithmetic and
+   re-derives FTRL's w with its own prox, so bitwise is not expected).
+
+Exit 0 on success; an assertion failure exits nonzero with the numpy
+diff in stderr.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_learner(model: str, mesh, max_delay: int):
+    if model == "linear":
+        from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+
+        cfg = LinearConfig(minibatch=128, num_buckets=1 << 10,
+                           nnz_per_row=16, algo="ftrl", lr_eta=0.5,
+                           lambda_l1=0.5, max_delay=max_delay,
+                           kernel="xla")
+        return LinearLearner(cfg, mesh)
+    from wormhole_tpu.models.difacto import DifactoConfig, DifactoLearner
+
+    cfg = DifactoConfig(minibatch=128, num_buckets=1 << 10,
+                        nnz_per_row=16, algo="ftrl", lr_eta=0.5,
+                        lambda_l1=0.5, dim=4, threshold=2,
+                        v_buckets=1 << 8, max_delay=max_delay,
+                        kernel="xla")
+    return DifactoLearner(cfg, mesh)
+
+
+def train(data: str, lrn, plane=None, passes: int = 2, parts: int = 2):
+    """Mirror apps/_runner._drain_round's cadence: maybe_sync per train
+    batch, flush at each part end."""
+    from wormhole_tpu.data.minibatch import MinibatchIter
+
+    for ep in range(passes):
+        for part in range(parts):
+            for blk in MinibatchIter(data, fmt="libsvm",
+                                     minibatch_size=128,
+                                     seed=ep * 7919 + part):
+                lrn.train_batch(blk)
+                if plane is not None:
+                    plane.maybe_sync()
+            if plane is not None:
+                plane.flush()
+
+
+def state_of(lrn) -> dict:
+    store = getattr(lrn, "ckpt_store", None) or lrn.store
+    return {k: np.asarray(v) for k, v in store.to_numpy().items()}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["linear", "difacto"],
+                    default="linear")
+    ap.add_argument("--max-delay", type=int, default=1)
+    ap.add_argument("--model-shards", type=int, default=2)
+    ap.add_argument("--data", required=True)
+    args = ap.parse_args()
+
+    import jax
+
+    assert jax.local_device_count() >= 4, (
+        "driver must set XLA_FLAGS=--xla_force_host_platform_device_count=4"
+    )
+    from wormhole_tpu.parallel.hot_plane import HotPlane
+    from wormhole_tpu.parallel.mesh import make_mesh
+    from wormhole_tpu.runtime.ps_server import PSClient, ServerNode
+
+    mesh = make_mesh(num_model=args.model_shards)
+
+    # reference: the plain single-copy learner, no PS plane at all
+    ref = build_learner(args.model, mesh, args.max_delay)
+    train(args.data, ref)
+
+    # hot plane over the SAME mesh shape, two-shard TCP cold tier
+    nodes = [ServerNode(r, 2) for r in range(2)]
+    for nd in nodes:
+        nd.serve()
+    client = PSClient([nd.uri for nd in nodes], sender="worker-0")
+    hot = build_learner(args.model, mesh, args.max_delay)
+    hot.track_touched = hasattr(hot, "collect_touched")
+    store = getattr(hot, "ckpt_store", None) or hot.store
+    plane = HotPlane(
+        store, client, max_delay=args.max_delay,
+        derived=getattr(hot, "derived_tables", dict)(),
+        touched_fn=getattr(hot, "collect_touched", None))
+    plane.init()
+    try:
+        train(args.data, hot, plane)
+
+        # 1. hot-plane training is bit-identical to the plain learner
+        ref_state, hot_state = state_of(ref), state_of(hot)
+        assert set(ref_state) == set(hot_state)
+        for k in sorted(ref_state):
+            np.testing.assert_array_equal(
+                ref_state[k], hot_state[k],
+                err_msg=f"table {k!r} diverged: the hot plane wrote the "
+                        "device store outside init adoption")
+
+        # 2. after the final flush the cold tier mirrors the device
+        merged = client.pull()
+        for k in sorted(merged):
+            np.testing.assert_allclose(
+                merged[k], hot_state[k], rtol=1e-4, atol=1e-6,
+                err_msg=f"cold tier table {k!r} drifted from the device")
+
+        # 3. and the plane did hot-plane accounting: steps counted, no
+        # per-step syncs (flushes only: passes * parts barriers + the
+        # back-to-back early-returns collapse repeats)
+        ws = plane.wire_stats()
+        assert ws["plane"] == "hot" and ws["devices"] >= 4, ws
+        assert ws["hot_steps"] > 0, ws
+        assert ws["num_syncs"] <= 2 * 2 + 1, ws
+    finally:
+        client.close()
+        for nd in nodes:
+            nd.stop()
+    print(f"hot_plane_check ok: model={args.model} "
+          f"max_delay={args.max_delay} shards={args.model_shards} "
+          f"flushes={plane.num_syncs}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,237 @@
+"""Hot parameter plane (parallel/hot_plane.py): device-resident tables
+with the TCP server group demoted to a flush-barrier cold tier.
+
+In-process tests cover the plane's contract against a real ServerNode
+group (no per-step wire traffic, flush-barrier reconciliation, pulls
+never writing the store, rollback self-healing). The bit-identity suite
+runs tests/hot_plane_check.py in a subprocess so
+XLA_FLAGS=--xla_force_host_platform_device_count=4 lands before jax
+imports — the acceptance gate for "the hot plane trains exactly like
+the plain single-copy learner".
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import synth_libsvm_text
+from wormhole_tpu.parallel.hot_plane import HotPlane
+from wormhole_tpu.runtime.ps_server import PSClient, ServerNode
+
+
+class _FakeStore:
+    """Host stand-in for a KVStore (scan-path SyncedStore surface)."""
+
+    def __init__(self, tables):
+        self.tables = {k: np.array(v, np.float32)
+                       for k, v in tables.items()}
+
+    def to_numpy(self):
+        return {k: v.copy() for k, v in self.tables.items()}
+
+    def from_numpy(self, arrays):
+        for k, v in arrays.items():
+            self.tables[k] = np.array(v, np.float32)
+
+    def zero_init_names(self):
+        return set(self.tables)
+
+
+@pytest.fixture
+def group():
+    nodes = [ServerNode(r, 2) for r in range(2)]
+    for n in nodes:
+        n.serve()
+    client = PSClient([n.uri for n in nodes], sender="worker-0")
+    yield nodes, client
+    client.close()
+    for n in nodes:
+        n.stop()
+
+
+def test_hot_steps_make_no_rpcs(group):
+    """The training path is wire-silent: maybe_sync only counts; the
+    cold tier sees traffic at flush barriers only."""
+    nodes, client = group
+    plane = HotPlane(_FakeStore({"w": np.zeros(16)}), client, max_delay=2)
+    plane.init()
+    b0 = client.bytes_push + client.bytes_pull
+    for _ in range(10):  # 5x max_delay: the TCP plane would sync 5 times
+        plane.store.tables["w"] += 1.0
+        assert plane.maybe_sync() is False
+    assert client.bytes_push + client.bytes_pull == b0
+    assert plane.num_syncs == 0
+    plane.flush()
+    assert plane.num_syncs == 1
+    np.testing.assert_array_equal(client.pull()["w"], np.full(16, 10.0))
+    # barrier right after a barrier: nothing new, no extra round-trip
+    s0 = plane.num_syncs
+    plane.flush()
+    assert plane.num_syncs == s0
+
+
+def test_hot_forces_sync_flush_even_under_async_env(group, monkeypatch):
+    """Chaos/bench drivers export WH_ASYNC_SYNC=1 for the TCP plane; the
+    hot plane's flush must stay synchronous regardless."""
+    monkeypatch.setenv("WH_ASYNC_SYNC", "1")
+    nodes, client = group
+    plane = HotPlane(_FakeStore({"w": np.zeros(4)}), client)
+    assert plane.async_sync is False
+
+
+def test_hot_pull_never_writes_store(group):
+    """Steady-state pulls refresh the base mirror only — the device
+    store is authoritative, and the cold tier is a MIRROR of it, not a
+    merge point. (Init adoption is the documented exception; merging
+    concurrent pushers is the TCP plane's regime.)"""
+    nodes, client = group
+    plane = HotPlane(_FakeStore({"w": np.zeros(8)}), client, max_delay=1)
+    plane.init()
+    # foreign rows land on the cold tier (e.g. a stale peer, an external
+    # writer): the hot plane must not let them reach the device
+    c2 = PSClient([n.uri for n in nodes], sender="worker-1")
+    c2.init_from_specs({"w"}, {"w": np.zeros(8, np.float32)})
+    c2.push({"w": np.full(8, 5.0, np.float32)})
+    # our pull sees them in the mirror, not in the device store
+    local = plane.store.tables["w"].copy()
+    plane.pull()
+    np.testing.assert_array_equal(plane.store.tables["w"], local)
+    np.testing.assert_array_equal(plane._base["w"], np.full(8, 5.0))
+    # and the next flush re-asserts device authority wholesale: the
+    # cur - base delta drives the server back to the device state, not
+    # to a merge of device + foreign rows
+    plane.store.tables["w"] += 1.0
+    plane.maybe_sync()
+    plane.flush()
+    np.testing.assert_array_equal(client.pull()["w"], np.full(8, 1.0))
+    np.testing.assert_array_equal(plane._base["w"], np.full(8, 1.0))
+    c2.close()
+
+
+def test_hot_plane_selfheals_after_server_restore(tmp_path):
+    """The PR 1 kill/restore contract under the hot plane: a server
+    rolled back to its snapshot is repaired wholesale by the next flush
+    (base re-zeroed for the restored shard, cur - base re-uploads the
+    authoritative device rows)."""
+    base = str(tmp_path / "srv")
+    node = ServerNode(0, 1)
+    node.serve()
+    holder = {"uris": None}
+    client = PSClient([node.uri], sender="w0", retry_deadline=15.0,
+                      resolver=lambda: holder["uris"])
+    plane = HotPlane(_FakeStore({"w": np.zeros(8)}), client, max_delay=1)
+    plane.init()
+    plane.store.tables["w"] += 1.0
+    plane.maybe_sync()
+    plane.flush()                       # server w=1 (seq 1)
+    node._snap_base = base
+    assert node.snapshot() is not None
+    plane.store.tables["w"] += 1.0
+    plane.maybe_sync()
+    plane.flush()                       # server w=2, NOT in the snapshot
+    node.stop()                         # SIGKILL stand-in
+
+    node2 = ServerNode(0, 1, epoch=1)
+    assert node2.restore_snapshot(base)
+    node2.serve()
+    holder["uris"] = [node2.uri]
+    try:
+        plane.store.tables["w"] += 1.0  # device (authoritative) w=3
+        plane.maybe_sync()
+        plane.flush()  # reconnect + journal replay + rollback re-pull
+        assert client.num_retries >= 1
+        np.testing.assert_array_equal(plane.store.tables["w"],
+                                      np.full(8, 3.0))
+        # cold tier matches the device again, base matches the server
+        np.testing.assert_array_equal(client.pull()["w"], np.full(8, 3.0))
+        np.testing.assert_array_equal(plane._base["w"], np.full(8, 3.0))
+        # and the repaired state keeps accumulating normally
+        plane.store.tables["w"] += 1.0
+        plane.maybe_sync()
+        plane.flush()
+        np.testing.assert_array_equal(client.pull()["w"], np.full(8, 4.0))
+    finally:
+        client.close()
+        node2.stop()
+
+
+def test_pick_plane_selection(monkeypatch):
+    """WH_PS_PLANE routing in the runner: explicit values honored,
+    invalid rejected, hot refused across processes, auto keyed on
+    in-process device count."""
+    import types
+
+    from wormhole_tpu.apps._runner import _pick_plane
+
+    env1 = types.SimpleNamespace(num_workers=1)
+    env2 = types.SimpleNamespace(num_workers=2)
+    monkeypatch.setenv("WH_PS_PLANE", "tcp")
+    assert _pick_plane(env1) == "tcp"
+    monkeypatch.setenv("WH_PS_PLANE", "bogus")
+    with pytest.raises(ValueError):
+        _pick_plane(env1)
+    monkeypatch.setenv("WH_PS_PLANE", "hot")
+    assert _pick_plane(env1) == "hot"
+    with pytest.raises(RuntimeError):
+        _pick_plane(env2)  # hot needs all workers in one process
+    monkeypatch.delenv("WH_PS_PLANE")
+    import jax
+
+    want = "hot" if jax.local_device_count() >= 2 else "tcp"
+    assert _pick_plane(env1) == want
+    assert _pick_plane(env2) == "tcp"
+
+
+def test_hot_wire_stats_plane_fields(group):
+    nodes, client = group
+    plane = HotPlane(_FakeStore({"w": np.zeros(4)}), client, max_delay=4)
+    plane.init()
+    plane.store.tables["w"] += 1.0
+    plane.maybe_sync()
+    plane.flush()
+    ws = plane.wire_stats()
+    assert ws["plane"] == "hot"
+    assert ws["hot_steps"] == 1 and ws["flushes"] == 1
+    # the TCP plane names itself too (bench rows key on this)
+    from wormhole_tpu.runtime.ps_server import SyncedStore
+
+    tcp = SyncedStore(_FakeStore({"w": np.zeros(4)}), client)
+    assert tcp.wire_stats()["plane"] == "tcp"
+
+
+# ------------------------------------------------ bit-identity subprocess
+@pytest.fixture(scope="module")
+def synth_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("hot") / "synth.libsvm"
+    p.write_text(synth_libsvm_text(n_rows=512, n_feat=300, nnz_per_row=12,
+                                   seed=5))
+    return str(p)
+
+
+def _run_check(synth_file, model, max_delay):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    script = os.path.join(os.path.dirname(__file__), "hot_plane_check.py")
+    r = subprocess.run(
+        [sys.executable, script, "--model", model,
+         "--max-delay", str(max_delay), "--data", synth_file],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (
+        f"hot_plane_check failed\nstdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+
+
+@pytest.mark.parametrize("max_delay", [1, 8])
+def test_hot_plane_bit_identity_linear(synth_file, max_delay):
+    """Hot-plane linear FTRL == plain learner, bitwise, on a forced
+    4-device CPU mesh (sync cadence and bounded staleness)."""
+    _run_check(synth_file, "linear", max_delay)
+
+
+@pytest.mark.parametrize("max_delay", [1, 8])
+def test_hot_plane_bit_identity_difacto(synth_file, max_delay):
+    """Same for the FM learner (two stores, derived w, count mirror)."""
+    _run_check(synth_file, "difacto", max_delay)

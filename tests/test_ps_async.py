@@ -421,8 +421,15 @@ def test_ps_lab_reports_all_stages():
     stages = {row["stage"] for row in rows}
     assert {"gather", "encode", "merge", "pull_read", "pull_apply",
             "wire", "sync_total", "keycache", "sync_loop",
-            "async_loop"} <= stages
+            "async_loop", "hot_gather", "hot_scatter", "hot_collective",
+            "hot_update", "hot_step_total", "hot_jit_cache"} <= stages
     kc = next(row for row in rows if row["stage"] == "keycache")
     assert kc["saving_frac"] > 0 and kc["hit_rate"] > 0.5
     al = next(row for row in rows if row["stage"] == "async_loop")
     assert al["overlap_frac"] >= 0.0
+    # hot-plane rows ran on a real sharded mesh, and the per-padded-size
+    # jit caches stop compiling once warm (the recompile-churn fix)
+    hg = next(row for row in rows if row["stage"] == "hot_gather")
+    assert hg["model_shards"] >= 2 and hg["devices"] >= 2
+    jc = next(row for row in rows if row["stage"] == "hot_jit_cache")
+    assert jc["misses_warmup"] >= 1 and jc["misses_steady"] == 0

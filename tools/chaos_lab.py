@@ -95,6 +95,17 @@ DEFAULT_SPECS = [
     "net:delay:ms=2",
 ]
 
+# --plane hot matrix: the hot plane only touches the wire at flush
+# barriers (passes x parts pushes total, plus init), so the TCP specs'
+# kill/reset counts would never fire — these are tuned to land inside
+# the handful of cold-tier reconciliations the job actually makes
+HOT_SPECS = [
+    "server:0:kill@push:3",
+    "server:0:kill@pull:3",
+    "net:reset:after_frames=20",
+    "net:delay:ms=2",
+]
+
 # --stack bsp matrix: (job name, app module, key=value argv builder,
 # fault specs). The kill counts are tuned to land mid-epoch: gbdt does 5
 # allreduces per round (4 tree levels + 1 eval metric block), so #6 is
@@ -144,7 +155,8 @@ def synth_libsvm(path: str, n_rows: int, seed: int, n_feat: int = 1000,
 def run_job(conf: str, spec: str, workers: int, servers: int,
             restarts: int, timeout: float,
             obs_dir: str | None = None,
-            async_sync: bool = True
+            async_sync: bool = True,
+            plane: str = "tcp"
             ) -> tuple[int, str, float, dict | None]:
     env = dict(os.environ, PYTHONPATH=REPO)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -154,6 +166,14 @@ def run_job(conf: str, spec: str, workers: int, servers: int,
     # async overlapped sync + key caching on (--sync-mode turns it off)
     env["WH_ASYNC_SYNC"] = "1" if async_sync else "0"
     env["WH_KEYCACHE"] = "1" if async_sync else "0"
+    env["WH_PS_PLANE"] = plane
+    if plane == "hot" and "host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        # the hot plane needs a real >= 2 device mesh in the (single)
+        # worker process; must land before that process imports jax
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=4"
+                            ).strip()
     if spec:
         env["WH_FAULT_SPEC"] = spec
     if obs_dir:
@@ -357,6 +377,12 @@ def main(argv=None) -> int:
                     help="--max-server-restarts (ps) or "
                          "--max-worker-restarts (bsp) for the faulted "
                          "runs")
+    ap.add_argument("--plane", choices=("tcp", "hot"), default="tcp",
+                    help="ps-stack parameter plane: tcp (per-sync wire "
+                         "traffic) or hot (device-resident tables, the "
+                         "server group demoted to a flush-barrier cold "
+                         "tier; forces workers=1 and a 4-device host "
+                         "mesh, and uses the HOT_SPECS fault matrix)")
     ap.add_argument("--sync-mode", action="store_true",
                     help="run with WH_ASYNC_SYNC=0 WH_KEYCACHE=0 (the "
                          "pre-overlap synchronous plane); default is "
@@ -380,8 +406,14 @@ def main(argv=None) -> int:
 
     if args.stack == "bsp":
         return bsp_matrix(args)
-    args.workers = args.workers or 2
-    args.specs = args.specs if args.specs is not None else DEFAULT_SPECS
+    if args.plane == "hot":
+        # the hot plane requires every data-parallel worker in ONE
+        # process (apps/_runner._pick_plane enforces it)
+        args.workers = 1
+    else:
+        args.workers = args.workers or 2
+    args.specs = args.specs if args.specs is not None else (
+        HOT_SPECS if args.plane == "hot" else DEFAULT_SPECS)
 
     scratch = tempfile.mkdtemp(prefix="wh_chaos_")
     for i in range(2):
@@ -389,6 +421,9 @@ def main(argv=None) -> int:
                      args.rows, seed=i)
     synth_libsvm(os.path.join(scratch, "val.libsvm"), args.rows, seed=9)
     conf = os.path.join(scratch, "chaos.conf")
+    # hot plane: shard the device tables over the forced host mesh so
+    # the scenario exercises the real sharded gather/scatter path
+    shards = "model_shards = 2\n" if args.plane == "hot" else ""
     with open(conf, "w") as fh:
         fh.write(f"""
 train_data = "{scratch}/train-.*"
@@ -402,16 +437,17 @@ num_buckets = 16384
 v_buckets = 4096
 max_data_pass = {args.passes}
 max_delay = 1
-""")
+{shards}""")
 
     restarts = 0 if args.no_recovery else args.restarts
-    print(f"[chaos] scratch={scratch} workers={args.workers} "
-          f"servers={args.servers} max_server_restarts={restarts}")
+    print(f"[chaos] scratch={scratch} plane={args.plane} "
+          f"workers={args.workers} servers={args.servers} "
+          f"max_server_restarts={restarts}")
 
     rc, out, dt, base_report = run_job(
         conf, "", args.workers, args.servers, restarts, args.timeout,
         obs_dir=os.path.join(scratch, "obs-baseline"),
-        async_sync=not args.sync_mode)
+        async_sync=not args.sync_mode, plane=args.plane)
     base = final_logloss(out)
     if rc != 0 or base is None:
         print(out[-4000:])
@@ -431,7 +467,7 @@ max_delay = 1
         rc, out, dt, report = run_job(
             conf, spec, args.workers, args.servers, restarts,
             args.timeout, obs_dir=os.path.join(scratch, f"obs-{i}"),
-            async_sync=not args.sync_mode)
+            async_sync=not args.sync_mode, plane=args.plane)
         ll = final_logloss(out)
         m = report_metrics(report)
         undeduped = m["journal_replays"] - m["replay_dedup_hits"]

@@ -14,11 +14,20 @@ vs steady state). Extends tools/ps_sync_micro.py, which only had the
 3-way gather/push/pull split; this is where PERF.md "PS plane" numbers
 come from.
 
-CPU-safe: defaults JAX_PLATFORMS=cpu when unset, so it runs anywhere
-the tests run (tests/test_ps_async.py wires it into the slow tier).
+The hot-plane stage table (hot_* rows) times the device-resident path
+the same sync rides when WH_PS_PLANE=hot: sharded row gather (ZPull),
+sharded row scatter (pull apply), the ZPush sharding-constraint
+collective (XLA reduce-scatter onto the owning model shard), and the
+shard-local optimizer update — plus the kv.jit_cache_misses steady
+state, which must be flat once every padded size has compiled.
+
+CPU-safe: defaults JAX_PLATFORMS=cpu when unset, and forces a
+multi-device host topology so the hot-plane rows exercise a real >= 2
+shard mesh anywhere the tests run (tests/test_ps_async.py wires it
+into the slow tier).
 
 Usage: python tools/ps_lab.py [--buckets N] [--nnz N] [--syncs N]
-       [--servers N] [--compute-ms MS] [--json]
+       [--servers N] [--compute-ms MS] [--model-shards N] [--json]
 """
 
 import argparse
@@ -28,6 +37,13 @@ import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# multi-device topology for the hot-plane stage rows; must land before
+# the first jax import, which is why it lives at module top
+if os.environ["JAX_PLATFORMS"] == "cpu" and \
+        "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4"
+                               ).strip()
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
@@ -118,6 +134,86 @@ def _teardown(nodes, client, ss):
         n.stop()
 
 
+def _hot_stage(args, emit):
+    """hot_* rows: per-stage ms of the device-resident (WH_PS_PLANE=hot)
+    data plane on a real model-sharded mesh. These are the stages a
+    training step actually rides — there is no wire, so the comparison
+    row for sync_total is hot_step_total."""
+    import jax
+    import jax.numpy as jnp
+
+    from wormhole_tpu.obs import metrics as _obs
+    from wormhole_tpu.parallel.kvstore import KVStore, TableSpec
+    from wormhole_tpu.parallel.mesh import make_mesh
+
+    nm = max(args.model_shards, 1)
+    nb = args.buckets - args.buckets % nm
+    mesh = make_mesh(num_model=nm)
+    store = KVStore(mesh, nb,
+                    {k: TableSpec() for k in ("w", "z", "n")})
+    rng = np.random.default_rng(1)
+    touched = np.unique(
+        rng.zipf(1.2, size=args.nnz).astype(np.int64) % nb)
+    vals = rng.standard_normal(touched.shape[0]).astype(np.float32)
+
+    def misses():
+        return int(_obs.REGISTRY.snapshot()["counters"]
+                   .get("kv.jit_cache_misses", 0))
+
+    # ZPush aggregation: a dense gradient in table layout pinned to the
+    # table's sharding — XLA reduce-scatters it onto the owning shard
+    coll = jax.jit(lambda g: store.constrain("z", g))
+
+    # shard-local FTRL-shaped update over the constrained gradient
+    def _upd(state, g):
+        z = state["z"] + g
+        n = state["n"] + g * g
+        w = (jnp.sign(z) * jnp.maximum(jnp.abs(z) - 1.0, 0.0)
+             / (1.0 + jnp.sqrt(n)))
+        return {"w": w, "z": z, "n": n}
+
+    upd = jax.jit(_upd, donate_argnums=0)
+    grad = jax.device_put(
+        np.zeros(nb, np.float32), store.sharding("z"))
+
+    # warmup: compile every padded size / program once
+    m0 = misses()
+    store.gather_rows_multi(["z", "n"], touched)
+    store.scatter_rows("w", touched, vals)
+    jax.block_until_ready(coll(grad))
+    store.state = upd(store.state, coll(grad))
+    jax.block_until_ready(store.state["w"])
+    warm = misses() - m0
+
+    g_s = s_s = c_s = u_s = 0.0
+    m1 = misses()
+    for _ in range(args.syncs):
+        t0 = time.perf_counter()
+        store.gather_rows_multi(["z", "n"], touched)
+        t1 = time.perf_counter()
+        store.scatter_rows("w", touched, vals)
+        t2 = time.perf_counter()
+        jax.block_until_ready(coll(grad))
+        t3 = time.perf_counter()
+        store.state = upd(store.state, grad)
+        jax.block_until_ready(store.state["w"])
+        t4 = time.perf_counter()
+        g_s += t1 - t0
+        s_s += t2 - t1
+        c_s += t3 - t2
+        u_s += t4 - t3
+    steady = misses() - m1
+    n = args.syncs
+    dims = dict(devices=int(mesh.devices.size), model_shards=nm)
+    emit("hot_gather", 1e3 * g_s / n, rows=int(touched.shape[0]), **dims)
+    emit("hot_scatter", 1e3 * s_s / n, rows=int(touched.shape[0]), **dims)
+    emit("hot_collective", 1e3 * c_s / n, table_rows=nb, **dims)
+    emit("hot_update", 1e3 * u_s / n, table_rows=nb, **dims)
+    emit("hot_step_total", 1e3 * (c_s + u_s) / n, **dims)
+    emit("hot_jit_cache", 0.0, misses_warmup=warm, misses_steady=steady,
+         **dims)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--buckets", type=int, default=1 << 22,
@@ -128,6 +224,10 @@ def main(argv=None):
     ap.add_argument("--servers", type=int, default=1)
     ap.add_argument("--compute-ms", type=float, default=50.0,
                     help="simulated device compute between async syncs")
+    ap.add_argument("--model-shards", type=int, default=2,
+                    help="mesh model-axis shards for the hot_* stage rows")
+    ap.add_argument("--no-hot", action="store_true",
+                    help="skip the hot-plane stage rows (no jax needed)")
     ap.add_argument("--json", action="store_true",
                     help="one JSON object per stage instead of a table")
     args = ap.parse_args(argv)
@@ -238,6 +338,10 @@ def main(argv=None):
              overlap_frac=ws["sync_overlap_frac"],
              keycache_hit_rate=ws["keycache_hit_rate"])
         _teardown(nodes, client, ss)
+
+    # ---- hot plane: the device-resident stage table (WH_PS_PLANE=hot)
+    if not args.no_hot:
+        _hot_stage(args, emit)
 
     if args.json:
         for r in rows:

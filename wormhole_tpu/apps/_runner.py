@@ -33,6 +33,7 @@ from wormhole_tpu.config import load_config
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import report as _report
 from wormhole_tpu.obs import trace as _trace
+from wormhole_tpu.parallel.hot_plane import HotPlane
 from wormhole_tpu.runtime.ps_server import PSClient, ServerNode, SyncedStore
 from wormhole_tpu.runtime.tracker import (
     RemotePool, Scheduler, SchedulerClient, node_env,
@@ -657,13 +658,20 @@ def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
                       retry_deadline=retry_sec,
                       resolver=_resolve if retry_sec > 0 else None)
         learner.track_touched = hasattr(learner, "collect_touched")
-        synced = SyncedStore(
+        plane = _pick_plane(env)
+        plane_cls = HotPlane if plane == "hot" else SyncedStore
+        synced = plane_cls(
             _store(learner), ps,
             max_delay=getattr(cfg, "max_delay", 16),
             fixed_bytes=getattr(cfg, "fixed_bytes", 0),
             derived=getattr(learner, "derived_tables", dict)(),
             touched_fn=getattr(learner, "collect_touched", None),
             compress=bool(getattr(cfg, "msg_compression", 0)))
+        if env.rank == 0:
+            import jax as _jax
+
+            print(f"[ps-plane] {plane} (workers={env.num_workers}, "
+                  f"local_devices={_jax.local_device_count()})", flush=True)
         synced.init()
     solver = MinibatchSolver(learner, cfg, verbose=False)
     if synced is not None:
@@ -733,6 +741,35 @@ def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
         solver.predict(cfg.val_data or cfg.train_data,
                        f"{cfg.predict_out}_rank-{env.rank}")
     return result
+
+
+def _pick_plane(env) -> str:
+    """Resolve WH_PS_PLANE. `hot` keeps the model device-resident
+    (sharded over the local mesh, aggregation in-jit) and demotes the
+    TCP servers to a flush-barrier cold tier — valid only when ALL
+    data-parallel workers share this process's device mesh. `auto`
+    picks hot exactly in that regime (one worker process, >= 2 local
+    devices) and the TCP plane everywhere else."""
+    plane = (os.environ.get("WH_PS_PLANE") or "auto").lower()
+    if plane not in ("auto", "tcp", "hot"):
+        raise ValueError(
+            f"WH_PS_PLANE={plane!r}: expected auto, tcp, or hot")
+    if plane == "tcp":
+        return "tcp"
+    import jax
+
+    if plane == "hot":
+        if env.num_workers > 1:
+            raise RuntimeError(
+                "WH_PS_PLANE=hot requires all data-parallel workers in "
+                f"one process (job has -n {env.num_workers}): the hot "
+                "plane's tables are sharded over the LOCAL device mesh, "
+                "and separate worker processes would each train a "
+                "private copy. Use -n 1 (the local mesh is the data "
+                "parallelism) or WH_PS_PLANE=tcp.")
+        return "hot"
+    return ("hot" if env.num_workers == 1 and jax.local_device_count() >= 2
+            else "tcp")
 
 
 def _store(learner):

@@ -262,6 +262,18 @@ declare_knob("WH_ASYNC_SYNC", bool, False,
 declare_knob("WH_KEYCACHE", bool, False,
              "Key-list digest caching on the PS wire (resend on miss).",
              group="ps")
+declare_knob("WH_PS_PLANE", str, "auto",
+             "Parameter plane: 'tcp' = SyncedStore push/pull RPCs every "
+             "max_delay steps, 'hot' = device-resident sharded tables with "
+             "in-jit collective aggregation and the TCP servers demoted to "
+             "a cold tier synced at flush barriers, 'auto' = hot when the "
+             "job's workers share one process with >=2 devices.",
+             group="ps")
+declare_knob("WH_NET_COMPRESS", bool, False,
+             "zlib-compress every PS wire frame (negotiated in hello; both "
+             "ends must enable it). Meant for the hot plane's cold-tier/"
+             "snapshot path and cross-pod sync, where flush frames are "
+             "large and rare.", group="ps")
 
 # BSP allreduce plane (runtime/allreduce.py)
 declare_knob("WH_BSP_STEP_TIMEOUT", float, 2.0,

@@ -93,7 +93,8 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            max_worker_restarts: int = 0,
            snapshot_dir: str | None = None,
            pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
-                                        "PYTHONPATH")) -> int:
+                                        "PYTHONPATH", "WH_PS_PLANE",
+                                        "WH_NET_COMPRESS")) -> int:
     """Spawn the scheduler + N workers of `cmd`; stream their output with
     role prefixes; return the first nonzero exit code (0 if all clean).
     On scheduler exit, surviving workers are terminated (the reference
@@ -391,6 +392,15 @@ def main(argv=None) -> int:
     ap.add_argument("--coord-port", type=int, default=0,
                     help="jax.distributed coordinator port on the first "
                          "host (global-mesh mode on pods)")
+    ap.add_argument("--plane", choices=("auto", "tcp", "hot"),
+                    default=None,
+                    help="parameter-plane selection for the spawned "
+                         "workers (exports WH_PS_PLANE): hot keeps the "
+                         "tables device-resident with the server group "
+                         "as a flush-barrier cold tier — requires all "
+                         "data-parallel workers in one process with "
+                         ">= 2 local devices; default: the workers' own "
+                         "WH_PS_PLANE / auto detection")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="program to launch (prefix with --)")
     args = ap.parse_args(argv)
@@ -406,6 +416,8 @@ def main(argv=None) -> int:
                       and not ln.startswith("#")]
     return launch(args.num_workers, args.num_servers, cmd,
                   node_timeout=args.node_timeout,
+                  env_extra=({"WH_PS_PLANE": args.plane}
+                             if args.plane else None),
                   hosts=hosts or None, ssh_cmd=args.ssh_cmd,
                   remote_cwd=args.remote_cwd,
                   scheduler_host=args.scheduler_host,

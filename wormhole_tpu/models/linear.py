@@ -113,6 +113,12 @@ class LinearConfig:
     num_buckets: int = 1 << 20
     nnz_per_row: int = 64
 
+    # in-process model-axis sharding: split the state tables over this
+    # many mesh "model" shards (HBM residency for the hot parameter
+    # plane; 1 = tables replicated, all devices on the data axis).
+    # num_buckets must divide evenly over the shards.
+    model_shards: int = 1
+
     # kernel = pallas (tiled MXU COO kernels, ops/coo_kernels.py) | xla
     # (segment ops) | auto (pallas on an unsharded-table TPU run, else xla)
     kernel: str = "auto"

@@ -34,6 +34,8 @@ COUNTERS: dict[str, str] = {
     "ps.keycache.hits": "key-list digests accepted by the server",
     "ps.keycache.misses": "digest misses forcing a full key resend",
     "ps.keycache.invalidations": "key caches dropped on restore/reconnect",
+    "ps.hot.steps": "train steps aggregated in-jit by the hot plane",
+    "ps.hot.flushes": "hot-plane cold-tier flush round-trips",
     "sched.liveness_evictions": "nodes evicted by the liveness loop",
     "sched.server_recoveries": "server re-registrations after death",
     "bsp.rounds": "BSP collective rounds completed (allreduce+broadcast)",
@@ -47,8 +49,11 @@ COUNTERS: dict[str, str] = {
     "net.bytes_sent": "bytes written to sockets",
     "net.bytes_recv": "bytes read from sockets",
     "net.connect_retries": "connect() attempts that needed a retry",
+    "net.compress.bytes_in": "compressed payload bytes received",
+    "net.compress.bytes_out": "compressed payload bytes sent",
     "kv.gather_rows": "rows gathered from the local kvstore",
     "kv.scatter_rows": "rows scattered into the local kvstore",
+    "kv.jit_cache_misses": "kvstore gather/scatter jit-cache compiles",
     "pack_cache.hits": "memory-tier pack cache hits",
     "pack_cache.misses": "pack cache misses (batch re-packed)",
     "pack_cache.disk_hits": "disk-tier pack cache hits",

@@ -72,6 +72,10 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "keycache_hits": c.get("ps.keycache.hits", 0),
         "keycache_misses": c.get("ps.keycache.misses", 0),
         "keycache_invalidations": c.get("ps.keycache.invalidations", 0),
+        "net_compress_bytes_in": c.get("net.compress.bytes_in", 0),
+        "net_compress_bytes_out": c.get("net.compress.bytes_out", 0),
+        "hot_plane_steps": c.get("ps.hot.steps", 0),
+        "hot_plane_flushes": c.get("ps.hot.flushes", 0),
         "bsp_rounds": c.get("bsp.rounds", 0),
         "bsp_recoveries": c.get("bsp.recoveries", 0),
         "bsp_ring_retries": c.get("bsp.ring_retries", 0),
@@ -164,6 +168,14 @@ def format_lines(report: dict) -> list[str]:
             f"  keycache: hits={s['keycache_hits']} "
             f"misses={s['keycache_misses']} "
             f"invalidations={s['keycache_invalidations']}")
+    if s.get("net_compress_bytes_in") or s.get("net_compress_bytes_out"):
+        lines.append(
+            f"  net compress: out={s['net_compress_bytes_out']}B "
+            f"in={s['net_compress_bytes_in']}B")
+    if s.get("hot_plane_steps") or s.get("hot_plane_flushes"):
+        lines.append(
+            f"  hot plane: steps={s['hot_plane_steps']} "
+            f"cold_flushes={s['hot_plane_flushes']}")
     return lines
 
 

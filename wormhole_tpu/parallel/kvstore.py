@@ -40,6 +40,7 @@ _GATHER_S = _obs.REGISTRY.histogram("kv.gather_s")
 _SCATTER_S = _obs.REGISTRY.histogram("kv.scatter_s")
 _GATHER_ROWS = _obs.REGISTRY.counter("kv.gather_rows")
 _SCATTER_ROWS = _obs.REGISTRY.counter("kv.scatter_rows")
+_JIT_MISSES = _obs.REGISTRY.counter("kv.jit_cache_misses")
 
 
 @dataclasses.dataclass
@@ -85,9 +86,15 @@ class KVStore:
                     out_shardings=sh,
                 )()
             self.state[name] = arr
-        self._gather_fn = jax.jit(lambda a, i: a[i])
+        # jitted gather/scatter caches, keyed by the _pad_pow2 padded
+        # length (and table set / name). jax.jit caches per shape
+        # internally, but an explicit per-size entry makes the compile
+        # set countable: kv.jit_cache_misses stays flat once every
+        # padded size in the touched-row distribution has been seen, so
+        # the lab can show steady-state compilation is zero.
+        self._gather_fns: dict[int, Callable] = {}
         self._multi_gather_fns: dict[tuple, Callable] = {}
-        self._scatter_fns: dict[str, Callable] = {}
+        self._scatter_fns: dict[tuple, Callable] = {}
 
     # -- helpers used inside learner-jitted steps ---------------------------
     def sharding(self, name: str):
@@ -127,7 +134,13 @@ class KVStore:
             return np.empty((0, *tail), np.float32)
         t0 = time.perf_counter()
         pad, n = self._pad_pow2(np.asarray(idx), 0)
-        out = self._gather_fn(self.state[name], jnp.asarray(pad))
+        m = pad.shape[0]
+        fn = self._gather_fns.get(m)
+        if fn is None:
+            fn = jax.jit(lambda a, i: a[i])
+            self._gather_fns[m] = fn
+            _JIT_MISSES.inc()
+        out = fn(self.state[name], jnp.asarray(pad))
         out = np.asarray(out[:n], dtype=np.float32)
         _GATHER_S.observe(time.perf_counter() - t0)
         _GATHER_ROWS.inc(n)
@@ -143,12 +156,14 @@ class KVStore:
             return {k: np.empty((0, *self.state[k].shape[1:]), np.float32)
                     for k in names}
         t0 = time.perf_counter()
-        key = tuple(names)
+        pad, n = self._pad_pow2(np.asarray(idx), 0)
+        names_key = tuple(names)
+        key = (names_key, pad.shape[0])
         fn = self._multi_gather_fns.get(key)
         if fn is None:
-            fn = jax.jit(lambda st, i: {k: st[k][i] for k in key})
+            fn = jax.jit(lambda st, i: {k: st[k][i] for k in names_key})
             self._multi_gather_fns[key] = fn
-        pad, n = self._pad_pow2(np.asarray(idx), 0)
+            _JIT_MISSES.inc()
         outs = fn({k: self.state[k] for k in names}, jnp.asarray(pad))
         res = {k: np.asarray(v[:n], dtype=np.float32)
                for k, v in outs.items()}
@@ -164,15 +179,17 @@ class KVStore:
         if idx.size == 0:
             return
         t0 = time.perf_counter()
-        fn = self._scatter_fns.get(name)
+        pad, n = self._pad_pow2(np.asarray(idx), self.state[name].shape[0])
+        key = (name, pad.shape[0])
+        fn = self._scatter_fns.get(key)
         if fn is None:
             sh = self.sharding(name)
             fn = jax.jit(
                 lambda a, i, v: jax.lax.with_sharding_constraint(
                     a.at[i].set(v, mode="drop"), sh),
                 donate_argnums=0)
-            self._scatter_fns[name] = fn
-        pad, n = self._pad_pow2(np.asarray(idx), self.state[name].shape[0])
+            self._scatter_fns[key] = fn
+            _JIT_MISSES.inc()
         tail = self.state[name].shape[1:]
         v = np.zeros((pad.shape[0], *tail), np.float32)
         v[:n] = vals

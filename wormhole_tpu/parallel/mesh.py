@@ -54,6 +54,10 @@ def make_mesh(
     assert need <= len(devs), (
         f"mesh {num_data}x{num_model} needs {need} devices, have {len(devs)}"
     )
+    assert num_data >= 1 and num_model >= 1, (
+        f"mesh {num_data}x{num_model} has an empty axis "
+        f"({len(devs)} devices can't fill {num_model} model shards)"
+    )
     devs = devs[:need]
     arr = np.array(devs).reshape(num_data, num_model)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))

@@ -57,6 +57,11 @@ _BYTES_RECV = _obs.REGISTRY.counter("net.bytes_recv")
 _CONNECT_RETRIES = _obs.REGISTRY.counter("net.connect_retries")
 _ENCODE_S = _obs.REGISTRY.histogram("net.encode_s")
 _DECODE_S = _obs.REGISTRY.histogram("net.decode_s")
+# frame-compression accounting (WH_NET_COMPRESS / per-call compress=):
+# compressed payload bytes that actually crossed the wire, both
+# directions, so the run report can state the codec's measured effect
+_COMPRESS_OUT = _obs.REGISTRY.counter("net.compress.bytes_out")
+_COMPRESS_IN = _obs.REGISTRY.counter("net.compress.bytes_in")
 
 
 def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
@@ -180,6 +185,9 @@ def send_frame(sock_file, header: dict,
     header = dict(header, arrays=metas)
     h = json.dumps(header).encode()
     _ENCODE_S.observe(time.perf_counter() - t0)
+    comp = sum(m["nbytes"] for m in metas if "comp" in m)
+    if comp:
+        _COMPRESS_OUT.inc(comp)
     sock_file.write(struct.pack(">I", len(h)))
     sock_file.write(h)
     total = 4 + len(h)
@@ -217,6 +225,8 @@ def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
         t0 = time.perf_counter()
         arrays[m["name"]] = _decode(m, buf)
         decode_s += time.perf_counter() - t0
+        if "comp" in m:
+            _COMPRESS_IN.inc(m["nbytes"])
     _DECODE_S.observe(decode_s)
     _FRAMES_RECV.inc()
     _BYTES_RECV.inc(total)

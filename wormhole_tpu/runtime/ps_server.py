@@ -157,17 +157,27 @@ class _PSHandler(socketserver.StreamRequestHandler):
                 node._conns.discard(self.connection)
 
     def _serve(self, node):
+        # frame compression (WH_NET_COMPRESS) is per-connection and
+        # hello-negotiated: it turns on only after a hello carrying
+        # net_compress=1 lands while this server has the knob set, and
+        # the ack in the reply is what arms the client side — either end
+        # left at the default keeps the whole connection uncompressed
+        fc = False
         while True:
             got = recv_frame(self.rfile)
             if got is None:
                 return
             header, arrays, _ = got
             resp_header, resp_arrays = node._dispatch(header, arrays)
+            if (header.get("op") == "hello" and header.get("net_compress")
+                    and node.net_compress):
+                fc = True
+                resp_header["net_compress"] = 1
             # every reply carries the server's restore epoch so clients
             # detect a respawned (rolled-back) server on any op
             resp_header.setdefault("epoch", node.epoch)
             send_frame(self.wfile, resp_header, resp_arrays,
-                       compress=bool(header.get("comp_reply")))
+                       compress=bool(header.get("comp_reply")) or fc)
             if header.get("op") == "shutdown":
                 self.server.node._shutdown.set()  # type: ignore
                 return
@@ -272,6 +282,10 @@ class ServerNode:
         # socket that strands them in recv)
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        # hello-negotiated zlib frame compression (WH_NET_COMPRESS):
+        # meant for the hot plane's cold-tier traffic — big, rare flush
+        # frames — where the codec cost amortizes; default off
+        self.net_compress = _env_flag("WH_NET_COMPRESS")
         self._srv = _PSServer((host, port), _PSHandler)
         self._srv.node = self  # type: ignore
         self.num_push = 0
@@ -1048,6 +1062,12 @@ class PSClient:
         # digest + values only
         self.keycache = (_env_flag("WH_KEYCACHE") if keycache is None
                          else bool(keycache))
+        # hello-negotiated frame compression (WH_NET_COMPRESS): when the
+        # knob is set here, every fresh connection's hello offers it and
+        # _fc[r] latches the server's ack — from then on every frame to
+        # that server ships zlib'd (replies ride the server's fc flag)
+        self.net_compress = _env_flag("WH_NET_COMPRESS")
+        self._fc = [False] * self.world
         self._kc_idx = [collections.OrderedDict()
                         for _ in range(self.world)]
         self._kc_pushed = [collections.OrderedDict()
@@ -1068,6 +1088,19 @@ class PSClient:
             s = connect_with_retry((host, int(port)), self.connect_deadline)
             self._socks[r] = s
             self._files[r] = s.makefile("rwb")
+            if self.net_compress:
+                # negotiate frame compression before any payload frame:
+                # the server arms its side of the connection on this
+                # hello and the ack arms ours; an old/default server
+                # simply doesn't ack and the connection stays raw
+                f = self._files[r]
+                send_frame(f, {"op": "hello", "sender": self.sender,
+                               "net_compress": 1})
+                got = recv_frame(f)
+                if got is None:
+                    raise ConnectionResetError(
+                        "connection closed during compression hello")
+                self._fc[r] = bool(got[0].get("net_compress"))
         return self._files[r]
 
     def _attempt(self, r: int, header: dict, arrays, fixed_bytes: int,
@@ -1076,7 +1109,8 @@ class PSClient:
         ConnectionResetError recv_frame's None maps to) means the
         connection is dead."""
         f = self._file(r)
-        sent = send_frame(f, header, arrays, fixed_bytes, compress)
+        sent = send_frame(f, header, arrays, fixed_bytes,
+                          compress or self._fc[r])
         got = recv_frame(f)
         if got is None:
             raise ConnectionResetError("connection closed mid-rpc")
@@ -1207,9 +1241,11 @@ class PSClient:
                     deadline_s=min(2.0, max(remaining, 0.1)))
                 self._socks[r] = s
                 self._files[r] = s.makefile("rwb")
-                h, _, _, _ = self._attempt(
-                    r, {"op": "hello", "sender": self.sender}, None, 0,
-                    False)
+                hello: dict = {"op": "hello", "sender": self.sender}
+                if self.net_compress:
+                    hello["net_compress"] = 1
+                h, _, _, _ = self._attempt(r, hello, None, 0, False)
+                self._fc[r] = bool(h.get("net_compress"))
                 self._note_epoch(r, h)
                 with self._stats_lock:  # shared tally; fan threads race
                     self.num_retries += 1
@@ -1273,6 +1309,7 @@ class PSClient:
                 pass
             self._socks[i] = None
             self._files[i] = None
+            self._fc[i] = False  # compression is per-connection state
         if r is None and self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -1977,7 +2014,8 @@ class SyncedStore:
         kc_total = c.kc_hits + c.kc_misses
         overlap = (max(0.0, 1.0 - self._wait_wall / self._rt_wall)
                    if self._rt_wall > 0 else 0.0)
-        return {"num_syncs": self.num_syncs,
+        return {"plane": "tcp",
+                "num_syncs": self.num_syncs,
                 "bytes_push": c.bytes_push,
                 "bytes_pull": c.bytes_pull,
                 "bytes_per_sync": (c.bytes_push + c.bytes_pull) / n,
