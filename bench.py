@@ -609,6 +609,37 @@ def emit_bsp():
              r.pop("allreduce_ms"), "ms", **r)
 
 
+def bench_serve(num_shards=2, num_buckets=1 << 26, duration_s=12.0):
+    """The serving tier at Criteo-1TB table scale: 2 in-process shards
+    each holding half the 64M-bucket w table, a router scoring
+    closed-loop predict batches through them, and a snapshot writer
+    forcing hot swaps mid-load so the row records swap count and the
+    request-visible stall (tools/serve_lab.py is the harness; this is
+    its bench operating point). The window is sized so a full 256 MB
+    set write (~2 s) + the watcher's slice load lands well inside it —
+    a 6 s run clocked zero in-window swaps."""
+    from tools.serve_lab import run as serve_run
+
+    return serve_run(num_shards=num_shards, num_buckets=num_buckets,
+                     minibatch=1000, nnz=64, duration_s=duration_s,
+                     concurrency=4, swap_every_s=2.0,
+                     verbose=False)
+
+
+def emit_serve():
+    row = _safe("serve", bench_serve)
+    if row is None:
+        return
+    emit("linear_ftrl_serve_64m_buckets", round(row["qps"], 1), "qps",
+         p50_ms=round(row["p50_ms"], 3), p99_ms=round(row["p99_ms"], 3),
+         p999_ms=round(row["p999_ms"], 3),
+         shards=row["shards"], concurrency=row["concurrency"],
+         requests=row["requests"], errors=row["errors"],
+         swap_count=row["swap_count"],
+         swap_stall_ms=round(row["swap_stall_ms"], 3),
+         epoch_retries=row["epoch_retries"])
+
+
 def _safe(what, fn, *args, **kw):
     """Failure isolation: one config blowing up must never suppress the
     lines after it — r3 lost its headline to exactly that (the PS bench
@@ -626,12 +657,17 @@ def main():
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--group", choices=["all", "bsp"], default="all",
+    ap.add_argument("--group", choices=["all", "bsp", "serve"],
+                    default="all",
                     help="run one bench group (bsp: the native BSP "
-                         "allreduce stack) instead of the full suite")
+                         "allreduce stack; serve: the online serving "
+                         "tier) instead of the full suite")
     args = ap.parse_args()
     if args.group == "bsp":
         emit_bsp()
+        return
+    if args.group == "serve":
+        emit_serve()
         return
     eps = _safe("difacto", bench_difacto)
     if eps is not None:
@@ -701,6 +737,7 @@ def main():
              loader_stall_s=round(stall, 4),
              loader_stall_frac=round(stall / max(wall, 1e-9), 4))
     emit_bsp()
+    emit_serve()
     # headline LAST: the driver parses the final JSON line. A headline
     # failure must stay LOUD (rc=1) — otherwise the previous line (a
     # different metric in different units) would silently be recorded

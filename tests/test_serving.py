@@ -1,0 +1,394 @@
+"""Serving tier: snapshot manifests, sharded fetch, bit-exact scoring,
+hot swap under load, backpressure, and chaos recovery."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wormhole_tpu.data.rowblock import RowBlock
+from wormhole_tpu.models.difacto import DifactoConfig, DifactoLearner
+from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.parallel.mesh import make_mesh
+from wormhole_tpu.runtime import net as _net
+from wormhole_tpu.serving import (
+    DifactoScorer, LinearScorer, ModelServer, Router, ServingModel,
+)
+from wormhole_tpu.utils import manifest as _manifest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _blk(rng, n=50, max_nnz=12):
+    counts = rng.integers(1, max_nnz, size=n)
+    offset = np.zeros(n + 1, np.int64)
+    offset[1:] = np.cumsum(counts)
+    return RowBlock(
+        label=np.zeros(n, np.float32),
+        offset=offset,
+        index=rng.integers(0, 1 << 62, size=int(offset[-1]),
+                           dtype=np.int64).astype(np.uint64),
+        value=rng.normal(size=int(offset[-1])).astype(np.float32),
+    )
+
+
+def _serve_group(base, world, **kw):
+    servers = [ModelServer(r, world, base, **kw) for r in range(world)]
+    for s in servers:
+        s.serve()
+    return servers
+
+
+# ---------------------------------------------------------------- manifest
+def test_snapshot_set_roundtrip(tmp_path):
+    base = str(tmp_path / "srv")
+    w = np.arange(100, dtype=np.float32)
+    V = np.arange(40, dtype=np.float32).reshape(20, 2)
+    v1 = _manifest.write_snapshot_set(base, {"w": w, "V": V}, world=2)
+    man = _manifest.read_manifest(base)
+    assert _manifest.complete(man)
+    assert man["full_rows"] == {"w": 100, "V": 20}
+    tables, meta = _manifest.load_slices(
+        base, {"w": (0, 100), "V": (0, 20)}, man)
+    assert np.array_equal(tables["w"], w)
+    assert np.array_equal(tables["V"], V)
+    assert meta["version"] == v1
+    # versions are monotone across rewrites
+    v2 = _manifest.write_snapshot_set(base, {"w": w * 2, "V": V}, world=2)
+    assert v2 > v1
+    # arbitrary sub-ranges spanning a part boundary come back exact
+    tables, _ = _manifest.load_slices(base, {"w": (30, 80)})
+    assert np.array_equal(tables["w"], w[30:80] * 2)
+
+
+def test_torn_snapshot_detected(tmp_path):
+    base = str(tmp_path / "srv")
+    _manifest.write_snapshot_set(
+        base, {"w": np.ones(64, np.float32)}, world=1)
+    man = _manifest.read_manifest(base)
+    # overwrite the part without updating the manifest: digest mismatch
+    np.savez(base + "_part-0.npz", w=np.zeros(64, np.float32))
+    with pytest.raises(_manifest.TornSnapshot):
+        _manifest.read_part(base, man, 0)
+    with pytest.raises(_manifest.TornSnapshot):
+        ServingModel(base, 0, 1, man)
+
+
+# ------------------------------------------------- bit-exact sharded predict
+def test_linear_serving_bitmatch_and_hot_swap(tmp_path):
+    """The tier-1 e2e: train a small linear model, snapshot it, serve it
+    from 2 shards through the router, and the scores BIT-match the
+    trainer's own predict; then a newer snapshot hot-swaps in."""
+    rng = np.random.default_rng(0)
+    cfg = LinearConfig(minibatch=64, num_buckets=1 << 12, nnz_per_row=16)
+    # 1x1 mesh: the scorer mirrors the trainer's SINGLE-DEVICE predict
+    # program; a data-sharded trainer compiles a different (equally
+    # valid) program that can differ by reassociation ulps
+    learner = LinearLearner(cfg, make_mesh(num_data=1, num_model=1))
+    train = _blk(rng, n=64)
+    train.label[:] = (rng.random(64) > 0.5).astype(np.float32)
+    for _ in range(3):
+        learner.train_batch(train)
+
+    base = str(tmp_path / "srv")
+    tables = {k: np.asarray(v) for k, v in learner.store.state.items()}
+    v1 = _manifest.write_snapshot_set(base, tables, world=2)
+    servers = _serve_group(base, 2)
+    router = Router([s.uri for s in servers], LinearScorer(cfg))
+    try:
+        blk = _blk(rng, n=50)
+        scores, version = router.predict_block(blk)
+        assert version == v1
+        ref = np.asarray(learner.predict_batch(blk))
+        assert np.array_equal(scores, ref[:50])  # bit-exact, not close
+
+        # a newer snapshot appears; shards hot-swap; scores follow it
+        for _ in range(2):
+            learner.train_batch(train)
+        tables2 = {k: np.asarray(v)
+                   for k, v in learner.store.state.items()}
+        v2 = _manifest.write_snapshot_set(base, tables2, world=2)
+        assert all(s.maybe_swap() for s in servers)
+        scores2, version2 = router.predict_block(blk)
+        assert version2 == v2 > v1
+        ref2 = np.asarray(learner.predict_batch(blk))
+        assert np.array_equal(scores2, ref2[:50])
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()
+
+
+def test_difacto_serving_bitmatch(tmp_path):
+    rng = np.random.default_rng(1)
+    cfg = DifactoConfig(minibatch=64, num_buckets=1 << 10,
+                        nnz_per_row=16, dim=4, threshold=2)
+    learner = DifactoLearner(cfg, make_mesh(num_data=1, num_model=1))
+    learner.store.state["w"] = jnp.asarray(
+        rng.normal(size=cfg.num_buckets).astype(np.float32))
+    learner.store.state["cnt"] = jnp.asarray(
+        rng.integers(0, 5, size=cfg.num_buckets).astype(np.float32))
+    learner.vstore.state["V"] = jnp.asarray(
+        (rng.normal(size=(cfg.vb, cfg.dim)) * 0.1).astype(np.float32))
+
+    base = str(tmp_path / "srv")
+    _manifest.write_snapshot_set(
+        base,
+        {"w": np.asarray(learner.store.state["w"]),
+         "cnt": np.asarray(learner.store.state["cnt"]),
+         "V": np.asarray(learner.vstore.state["V"])},
+        world=3)
+    servers = _serve_group(base, 3)
+    router = Router([s.uri for s in servers], DifactoScorer(cfg))
+    try:
+        blk = _blk(rng, n=40)
+        scores, _ = router.predict_block(blk)
+        ref = np.asarray(learner.predict_batch(blk))
+        assert np.array_equal(scores, ref[:40])
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()
+
+
+def test_router_world_sizes_agree(tmp_path):
+    """The serve world is a deployment choice: 1-shard and 3-shard
+    groups over the same snapshot produce identical bits."""
+    rng = np.random.default_rng(2)
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
+    base = str(tmp_path / "srv")
+    _manifest.write_snapshot_set(
+        base, {"w": rng.normal(size=cfg.num_buckets).astype(np.float32)},
+        world=2)
+    blk = _blk(rng, n=30)
+    got = {}
+    for world in (1, 3):
+        servers = _serve_group(base, world)
+        router = Router([s.uri for s in servers], LinearScorer(cfg))
+        try:
+            got[world], _ = router.predict_block(blk)
+        finally:
+            router.close()
+            for s in servers:
+                s.stop()
+    assert np.array_equal(got[1], got[3])
+
+
+# ------------------------------------------------------- swap under load
+def test_hot_swap_under_load_no_mixed_versions(tmp_path):
+    """Concurrent predicts while snapshots keep swapping: every batch's
+    scores must match the version its reply claims — no drops, no
+    mixed-version batches."""
+    rng = np.random.default_rng(3)
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
+    base = str(tmp_path / "srv")
+    versions = {}  # snapshot version -> the w constant it carries
+    v = _manifest.write_snapshot_set(
+        base, {"w": np.full(cfg.num_buckets, 1.0, np.float32)}, world=2)
+    versions[v] = 1.0
+    servers = _serve_group(base, 2, poll_sec=0.02)
+    router = Router([s.uri for s in servers], LinearScorer(cfg))
+    scorer = LinearScorer(cfg)
+    blocks = [_blk(rng, n=32) for _ in range(4)]
+    results, errors = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def load(tid):
+        i = tid
+        while not stop.is_set():
+            try:
+                scores, ver = router.predict_block(
+                    blocks[i % len(blocks)])
+                with lock:
+                    results.append((i % len(blocks), scores, ver))
+            except Exception as e:
+                with lock:
+                    errors.append(e)
+            i += 3
+
+    threads = [threading.Thread(target=load, args=(t,), daemon=True)
+               for t in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        for k in (2.0, 3.0, 4.0):
+            time.sleep(0.15)
+            v = _manifest.write_snapshot_set(
+                base, {"w": np.full(cfg.num_buckets, k, np.float32)},
+                world=2)
+            versions[v] = k
+        deadline = time.monotonic() + 10
+        while (any(s.version != v for s in servers)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.close()
+        for s in servers:
+            s.stop()
+    assert not errors
+    assert {ver for _, _, ver in results} >= {min(versions), max(versions)}
+    # recompute each batch's expected scores AT ITS REPORTED VERSION;
+    # a mixed-version fetch the router failed to catch would mismatch
+    expected = {}
+    for bi, scores, ver in results:
+        assert ver in versions, f"reply carries unknown version {ver}"
+        if (bi, ver) not in expected:
+            packed = scorer.pack(blocks[bi])
+            w_full = np.full(cfg.num_buckets, versions[ver], np.float32)
+            expected[bi, ver] = scorer.score(
+                packed, {"w": w_full[packed.keys["w"]]})
+        assert np.array_equal(scores, expected[bi, ver])
+
+
+# --------------------------------------------------------- backpressure
+def test_busy_bounce_is_retried_and_exactly_once(tmp_path):
+    """A gate-bounced fetch is resent with the SAME seq after the busy
+    backoff, and a replayed seq is answered from the reply cache with
+    the ORIGINAL version even after a swap."""
+    rng = np.random.default_rng(4)
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
+    base = str(tmp_path / "srv")
+    v1 = _manifest.write_snapshot_set(
+        base, {"w": np.ones(cfg.num_buckets, np.float32)}, world=1)
+    (server,) = _serve_group(base, 1)
+
+    class _BouncyGate:
+        def __init__(self, bounces):
+            self.bounces = bounces
+
+        def try_enter(self):
+            if self.bounces > 0:
+                self.bounces -= 1
+                return False
+            return True
+
+        def leave(self):
+            pass
+
+    # install the bouncy gate AFTER the Router's constructor hello so
+    # the bounces land on the measured predict fetch
+    router = Router([server.uri], LinearScorer(cfg))
+    server._gate = _BouncyGate(2)
+    retries0 = _obs.REGISTRY.counter("net.busy.retries").value()
+    try:
+        blk = _blk(rng, n=16)
+        scores, ver = router.predict_block(blk)
+        assert ver == v1
+        assert _obs.REGISTRY.counter("net.busy.retries").value() \
+            >= retries0 + 2
+
+        # replay the last fetch seq by hand: the cached reply must come
+        # back verbatim — same OLD version — even after a hot swap
+        host, port = server.uri.rsplit(":", 1)
+        sock = _net.connect_with_retry((host, int(port)), 5.0)
+        f = sock.makefile("rwb")
+        keys = np.arange(4, dtype=np.int64)
+        hdr = {"op": "fetch", "tables": ["w"], "sender": "replayer",
+               "seq": 7}
+        _net.send_frame(f, hdr, {"k:w": keys})
+        r1, a1, _ = _net.recv_frame(f)
+        v2 = _manifest.write_snapshot_set(
+            base, {"w": np.zeros(cfg.num_buckets, np.float32)}, world=1)
+        assert server.maybe_swap() and server.version == v2
+        dedup0 = _obs.REGISTRY.counter("serve.dedup_hits").value()
+        _net.send_frame(f, hdr, {"k:w": keys})
+        r2, a2, _ = _net.recv_frame(f)
+        assert r2["version"] == r1["version"] == v1
+        assert np.array_equal(a1["r:w"], a2["r:w"])
+        assert _obs.REGISTRY.counter("serve.dedup_hits").value() \
+            == dedup0 + 1
+        # a NEW seq sees the new version
+        _net.send_frame(f, dict(hdr, seq=8), {"k:w": keys})
+        r3, a3, _ = _net.recv_frame(f)
+        assert r3["version"] == v2
+        assert np.array_equal(a3["r:w"], np.zeros(4, np.float32))
+        sock.close()
+    finally:
+        router.close()
+        server.stop()
+
+
+# --------------------------------------------------------- control plane
+def test_scheduler_serve_registry():
+    from wormhole_tpu.runtime.tracker import Scheduler, SchedulerClient
+
+    sched = Scheduler(num_workers=0, num_servers=0, straggler=False)
+    sched.serve()
+    try:
+        client = SchedulerClient(sched.uri, "test")
+        r = client.call(op="serve_nodes", world=2)
+        assert not r["ready"] and r["num_known"] == 0
+        client.call(op="register_serve", rank=0, uri="127.0.0.1:1000")
+        client.call(op="register_serve", rank=1, uri="127.0.0.1:1001")
+        r = client.call(op="serve_nodes", world=2)
+        assert r["ready"]
+        assert r["uris"] == ["127.0.0.1:1000", "127.0.0.1:1001"]
+        # same-uri re-registration is idempotent, a NEW uri is a recovery
+        client.call(op="register_serve", rank=1, uri="127.0.0.1:1001")
+        assert sched.num_serve_recoveries == 0
+        client.call(op="register_serve", rank=1, uri="127.0.0.1:2001")
+        assert sched.num_serve_recoveries == 1
+        r = client.call(op="serve_nodes", world=2)
+        assert r["uris"][1] == "127.0.0.1:2001"
+    finally:
+        sched.stop()
+
+
+def test_serve_role_env():
+    from wormhole_tpu.runtime.tracker import Role, node_env
+
+    env_backup = dict(os.environ)
+    try:
+        os.environ.update(WH_ROLE="serve", WH_RANK="1", WH_NUM_SERVE="3")
+        env = node_env()
+        assert env.role is Role.SERVE
+        assert env.rank == 1 and env.num_serve == 3
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+# ------------------------------------------------------------- serve_lab
+def test_serve_lab_smoke():
+    sys.path.insert(0, REPO)
+    from tools.serve_lab import run
+
+    row = run(num_shards=2, num_buckets=1 << 14, minibatch=64, nnz=8,
+              duration_s=1.0, concurrency=2, swap_every_s=0.4,
+              verbose=False)
+    assert row["errors"] == 0
+    assert row["requests"] > 0 and row["qps"] > 0
+    assert row["p99_ms"] >= row["p50_ms"] > 0
+    assert row["swap_count"] >= 2  # both shards swapped at least once
+
+
+@pytest.mark.slow
+def test_serve_lab_chaos_zero_failures():
+    """Kill a serving shard mid-load; the router must ride it out with
+    zero failed requests (the run itself asserts this and exits 1
+    otherwise)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_lab.py"),
+         "--chaos", "--duration", "4", "--buckets", str(1 << 16),
+         "--minibatch", "128", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("[serve-lab] ")][-1]
+    row = json.loads(line[len("[serve-lab] "):])
+    assert row["errors"] == 0
+    assert row["respawns"] == 1
+    assert row["router_retries"] >= 1

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Serving-tier load lab: latency/QPS for the router + shard predict path.
+
+Spins up an in-process serving group (N ModelServer shards over a
+write_snapshot_set snapshot) and drives it through the Router with a
+closed-loop (fixed concurrency, each thread fires its next request the
+moment the last returns) or open-loop (Poisson-paced target QPS;
+latency is measured from the SCHEDULED arrival, so queueing delay
+shows up in the tail instead of being absorbed by backpressure)
+generator. Reports p50/p99/p999 latency, achieved QPS, and error rate
+— plus hot-swap counts/stall when --swap writes newer snapshot
+versions mid-load, and shard kill/respawn recovery when --chaos kills
+a shard mid-load (the run asserts ZERO failed requests: the router
+must absorb the death through redial + seq-replayed fetches).
+
+This is where PERF.md serving numbers and the bench.py --group serve
+row come from; the final line is machine-readable:
+
+    [serve-lab] {"qps": ..., "p50_ms": ..., "p99_ms": ..., ...}
+
+Usage: python tools/serve_lab.py [--shards N] [--buckets N] [--nnz N]
+       [--duration S] [--concurrency N] [--open-qps Q] [--swap]
+       [--chaos] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from wormhole_tpu.data.rowblock import RowBlock
+from wormhole_tpu.models.linear import LinearConfig
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.serving import LinearScorer, ModelServer, Router
+from wormhole_tpu.utils.manifest import write_snapshot_set
+
+
+def _synth_blocks(rng, num_blocks: int, minibatch: int, nnz: int):
+    """A pool of distinct predict batches (reused round-robin so the
+    load is not one memoized key set)."""
+    out = []
+    for _ in range(num_blocks):
+        n = minibatch
+        counts = rng.integers(max(nnz // 2, 1), nnz + 1, size=n)
+        offset = np.zeros(n + 1, np.int64)
+        offset[1:] = np.cumsum(counts)
+        out.append(RowBlock(
+            label=np.zeros(n, np.float32),
+            offset=offset,
+            index=rng.integers(0, 1 << 62, size=int(offset[-1]),
+                               dtype=np.int64).astype(np.uint64),
+            value=rng.normal(size=int(offset[-1])).astype(np.float32),
+        ))
+    return out
+
+
+def _pct(lat_ms: list, q: float) -> float:
+    if not lat_ms:
+        return float("nan")
+    s = sorted(lat_ms)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def run(num_shards: int = 2, num_buckets: int = 1 << 20,
+        minibatch: int = 256, nnz: int = 32, duration_s: float = 3.0,
+        concurrency: int = 4, open_qps: float = 0.0,
+        swap_every_s: float = 0.0, chaos_at_s: float = 0.0,
+        seed: int = 0, verbose: bool = True) -> dict:
+    """Drive one load run; returns the result row (the [serve-lab] dict).
+
+    swap_every_s > 0: write a newer snapshot version every interval —
+    the shard watchers hot-swap under load.
+    chaos_at_s > 0: hard-stop shard 0 at that offset and respawn it on
+    a NEW port; the router must recover through the resolver with zero
+    failed requests.
+    """
+    rng = np.random.default_rng(seed)
+    cfg = LinearConfig(minibatch=minibatch, num_buckets=num_buckets,
+                       nnz_per_row=nnz)
+    tmp = tempfile.mkdtemp(prefix="wh_serve_lab_")
+    base = os.path.join(tmp, "srv")
+    # zeros: the lab measures the serving path, not the model; rows move
+    # over the wire either way
+    # uncompressed: at bench scale (64M buckets) a compressed 256 MB set
+    # write outlasts the swap interval and no swap lands in the window
+    write_snapshot_set(base, {"w": np.zeros(num_buckets, np.float32)},
+                       world=num_shards, clock=0, epoch=0,
+                       compressed=False)
+
+    servers = [ModelServer(r, num_shards, base, poll_sec=0.05)
+               for r in range(num_shards)]
+    for s in servers:
+        s.serve()
+    uris = [s.uri for s in servers]  # mutated by the chaos respawn
+    state = {"servers": servers, "uris": list(uris), "respawns": 0}
+    state_lock = threading.Lock()
+
+    def resolver():
+        with state_lock:
+            return list(state["uris"])
+
+    router = Router(resolver(), LinearScorer(cfg), resolver=resolver,
+                    retry_deadline=max(30.0, duration_s * 2))
+    blocks = _synth_blocks(rng, 8, minibatch, nnz)
+    # warm the jit caches so compile time is not in the measured window
+    router.predict_block(blocks[0])
+
+    before = _obs.REGISTRY.snapshot()
+    lat_ms: list = []
+    errors = [0]
+    done = [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+    t_start = time.perf_counter()
+    deadline = t_start + duration_s
+
+    def loop(tid: int):
+        lrng = np.random.default_rng(seed + 1000 + tid)
+        local_lat, local_done, local_err = [], 0, 0
+        i = tid
+        # open loop: each thread owns an independent Poisson arrival
+        # process at open_qps/concurrency
+        next_at = time.perf_counter()
+        while not stop.is_set() and time.perf_counter() < deadline:
+            if open_qps > 0:
+                now = time.perf_counter()
+                if now < next_at:
+                    time.sleep(next_at - now)
+                sched = next_at
+                next_at += lrng.exponential(concurrency / open_qps)
+            else:
+                sched = time.perf_counter()
+            try:
+                router.predict_block(blocks[i % len(blocks)])
+                local_lat.append((time.perf_counter() - sched) * 1e3)
+                local_done += 1
+            except Exception as e:
+                local_err += 1
+                if verbose:
+                    print(f"[serve-lab] request failed: {e!r}", flush=True)
+            i += concurrency
+        with lock:
+            lat_ms.extend(local_lat)
+            done[0] += local_done
+            errors[0] += local_err
+
+    def swapper():
+        epoch = 0
+        while not stop.wait(swap_every_s):
+            epoch += 1
+            write_snapshot_set(
+                base, {"w": np.full(num_buckets, float(epoch),
+                                    np.float32)},
+                world=num_shards, clock=epoch, epoch=epoch,
+                compressed=False)
+
+    def chaos():
+        if stop.wait(chaos_at_s):
+            return
+        with state_lock:
+            victim = state["servers"][0]
+        if verbose:
+            print("[serve-lab] chaos: killing shard 0", flush=True)
+        victim.stop()
+        time.sleep(0.2)  # let in-flight RPCs hit the dead socket
+        replacement = ModelServer(0, num_shards, base, poll_sec=0.05)
+        replacement.serve()
+        with state_lock:
+            state["servers"][0] = replacement
+            state["uris"][0] = replacement.uri
+            state["respawns"] += 1
+        if verbose:
+            print(f"[serve-lab] chaos: shard 0 respawned at "
+                  f"{replacement.uri}", flush=True)
+
+    threads = [threading.Thread(target=loop, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    extras = []
+    if swap_every_s > 0:
+        extras.append(threading.Thread(target=swapper, daemon=True))
+    if chaos_at_s > 0:
+        extras.append(threading.Thread(target=chaos, daemon=True))
+    for t in threads + extras:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in extras:
+        t.join(timeout=5)
+    elapsed = time.perf_counter() - t_start
+
+    after = _obs.REGISTRY.snapshot()
+
+    def delta(name: str) -> int:
+        return (after["counters"].get(name, 0)
+                - before["counters"].get(name, 0))
+
+    stall_h = after["hists"].get("serve.swap_stall_s") or {}
+    stall_before = before["hists"].get("serve.swap_stall_s") or {}
+    stall_ms = ((stall_h.get("sum", 0.0) - stall_before.get("sum", 0.0))
+                * 1e3)
+    row = {
+        "shards": num_shards,
+        "buckets": num_buckets,
+        "minibatch": minibatch,
+        "mode": "open" if open_qps > 0 else "closed",
+        "concurrency": concurrency,
+        "requests": done[0],
+        "errors": errors[0],
+        "error_rate": errors[0] / max(done[0] + errors[0], 1),
+        "qps": done[0] / elapsed,
+        "p50_ms": _pct(lat_ms, 0.50),
+        "p99_ms": _pct(lat_ms, 0.99),
+        "p999_ms": _pct(lat_ms, 0.999),
+        "swap_count": delta("serve.swaps"),
+        "swap_stall_ms": stall_ms,
+        "router_retries": delta("serve.router.retries"),
+        "epoch_retries": delta("serve.router.epoch_retries"),
+        "respawns": state["respawns"],
+    }
+    router.close()
+    with state_lock:
+        servers = list(state["servers"])
+    for s in servers:
+        s.stop()
+    if chaos_at_s > 0 and errors[0]:
+        raise AssertionError(
+            f"chaos run dropped {errors[0]} requests; the router must "
+            "absorb a shard death with zero failures")
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--buckets", type=int, default=1 << 20)
+    ap.add_argument("--minibatch", type=int, default=256)
+    ap.add_argument("--nnz", type=int, default=32)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--open-qps", type=float, default=0.0,
+                    help="open-loop target QPS (0 = closed loop)")
+    ap.add_argument("--swap", action="store_true",
+                    help="write a newer snapshot version every 0.5s "
+                         "so the shards hot-swap under load")
+    ap.add_argument("--chaos", action="store_true",
+                    help="kill shard 0 mid-load and respawn it on a "
+                         "new port; fails unless zero requests failed")
+    ap.add_argument("--json", action="store_true",
+                    help="print only the [serve-lab] machine line")
+    args = ap.parse_args(argv)
+    row = run(num_shards=args.shards, num_buckets=args.buckets,
+              minibatch=args.minibatch, nnz=args.nnz,
+              duration_s=args.duration, concurrency=args.concurrency,
+              open_qps=args.open_qps,
+              swap_every_s=0.5 if args.swap else 0.0,
+              chaos_at_s=args.duration / 3 if args.chaos else 0.0,
+              verbose=not args.json)
+    if not args.json:
+        print(f"{row['mode']}-loop x{row['concurrency']}: "
+              f"{row['qps']:.0f} qps, p50 {row['p50_ms']:.2f} ms, "
+              f"p99 {row['p99_ms']:.2f} ms, p999 {row['p999_ms']:.2f} "
+              f"ms, {row['requests']} ok / {row['errors']} failed, "
+              f"{row['swap_count']} swaps "
+              f"({row['swap_stall_ms']:.2f} ms stall), "
+              f"{row['respawns']} respawns", flush=True)
+    print("[serve-lab] " + json.dumps(row, sort_keys=True), flush=True)
+    return 0 if row["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

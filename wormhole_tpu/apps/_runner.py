@@ -60,6 +60,12 @@ def run_minibatch_app(cfg, make_learner, verbose: bool = True) -> dict:
     if env.role is None:
         learner = make_learner(cfg, env)
         return MinibatchSolver(learner, cfg, verbose=verbose).run()
+    if env.role.value == "serve":
+        # online serving shard: independent of the train data plane, so
+        # it dispatches the same way under global_mesh or PS mode
+        from wormhole_tpu.serving.server import run_serve_role
+
+        return run_serve_role(cfg, env)
     if getattr(cfg, "global_mesh", False):
         # one SPMD program over every worker's devices (parallel/multihost)
         if env.role.value == "scheduler":

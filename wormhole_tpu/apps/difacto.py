@@ -31,6 +31,13 @@ def make_learner(cfg: DifactoConfig, env):
     return DifactoLearner(cfg, mesh)
 
 
+def serve_scorer(cfg: DifactoConfig):
+    """Scorer for the serving tier (router-side predict math)."""
+    from wormhole_tpu.serving.scoring import DifactoScorer
+
+    return DifactoScorer(cfg)
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cfg = parse_cli(DifactoConfig, argv)

@@ -29,6 +29,13 @@ def make_learner(cfg: LinearConfig, env):
     return LinearLearner(cfg, mesh)
 
 
+def serve_scorer(cfg: LinearConfig):
+    """Scorer for the serving tier (router-side predict math)."""
+    from wormhole_tpu.serving.scoring import LinearScorer
+
+    return LinearScorer(cfg)
+
+
 def main(argv=None) -> int:
     return app_main(LinearConfig, make_learner, argv)
 

@@ -275,6 +275,29 @@ declare_knob("WH_NET_COMPRESS", bool, False,
              "snapshot path and cross-pod sync, where flush frames are "
              "large and rare.", group="ps")
 
+declare_knob("WH_NET_MAX_INFLIGHT", int, 0,
+             "Max requests a frame server (PS shard / serving shard) admits "
+             "concurrently; overflow gets a structured `busy` reply the "
+             "client backs off on and retries (0 = unlimited).",
+             group="ps")
+
+# online serving tier (wormhole_tpu/serving/)
+declare_knob("WH_NUM_SERVE", int, 0,
+             "Serving-shard count the launcher's --serve role group exports.",
+             group="serve")
+declare_knob("WH_SERVE_SNAPSHOT", str, "",
+             "Snapshot base path the serving shards load and watch "
+             "(default: <WH_SNAPSHOT_DIR>/srv — the trainer's PS shard "
+             "snapshots).", group="serve")
+declare_knob("WH_SERVE_POLL_SEC", float, 1.0,
+             "Hot-swap watcher poll interval: how often a serving shard "
+             "checks the snapshot manifest for a newer model version.",
+             group="serve")
+declare_knob("WH_SERVE_RETRY_SEC", float, 30.0,
+             "Router-side retry window for a dead serving shard: how long "
+             "predict fan-outs re-resolve and redial before a batch fails.",
+             group="serve")
+
 # BSP allreduce plane (runtime/allreduce.py)
 declare_knob("WH_BSP_STEP_TIMEOUT", float, 2.0,
              "Seconds a BSP worker blocks on one ring step before "

@@ -91,6 +91,8 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            coord_port: int = 0,
            max_server_restarts: int = 0,
            max_worker_restarts: int = 0,
+           num_serve: int = 0,
+           max_serve_restarts: int = 0,
            snapshot_dir: str | None = None,
            pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
                                         "PYTHONPATH", "WH_PS_PLANE",
@@ -124,11 +126,21 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     generation), loads its version-stamped checkpoint from
     `snapshot_dir`, and replays its missed collectives from peers'
     result caches. Unlike supervised servers, a worker's FINAL exit
-    code always folds into the job's: workers define job success."""
+    code always folds into the job's: workers define job success.
+
+    `num_serve > 0` adds a group of online serving shards
+    (serving/server.py): each loads its range of the newest snapshot
+    set under WH_SNAPSHOT_DIR (or WH_SERVE_SNAPSHOT), registers its
+    predict endpoint with the scheduler, and hot-swaps as training
+    writes newer versions. Serving is infrastructure, not workload:
+    shard exit codes never fold into the job's (the launcher kills
+    leftovers at teardown), and `max_serve_restarts > 0` respawns a
+    shard that dies mid-job — routers chase the new uri through the
+    scheduler's serve_nodes op."""
     multi = bool(hosts)
     recovery = max_server_restarts > 0 and num_servers > 0
     recovery_w = max_worker_restarts > 0 and num_workers > 0
-    if (recovery or recovery_w) and snapshot_dir is None:
+    if (recovery or recovery_w or num_serve > 0) and snapshot_dir is None:
         import tempfile
 
         snapshot_dir = tempfile.mkdtemp(prefix="wh_ps_snap_")
@@ -162,6 +174,7 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             WH_RANK=str(rank),
             WH_NUM_WORKERS=str(num_workers),
             WH_NUM_SERVERS=str(num_servers),
+            WH_NUM_SERVE=str(num_serve),
             WH_SCHEDULER_URI=uri,
             WH_COORD_URI=coord_uri,
             WH_NODE_TIMEOUT=str(node_timeout),
@@ -198,10 +211,15 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
 
     def spawn_remote(role: str, rank: int,
                      extra: dict | None = None) -> subprocess.Popen:
-        # workers spread over hosts by rank; servers continue the
-        # round-robin after them so a host gets at most
-        # ceil((n+s)/len(hosts)) processes
-        slot = rank if role == "worker" else num_workers + rank
+        # workers spread over hosts by rank; servers, then serving
+        # shards, continue the round-robin after them so a host gets at
+        # most ceil((n+s+serve)/len(hosts)) processes
+        if role == "worker":
+            slot = rank
+        elif role == "server":
+            slot = num_workers + rank
+        else:  # serve
+            slot = num_workers + num_servers + rank
         host = hosts[slot % len(hosts)]
         kv = dict(contract(role, rank))
         if extra:
@@ -221,9 +239,11 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     sched = spawn("scheduler", 0)  # the tracker node always runs locally
     server_procs = {r: role_spawn("server", r) for r in range(num_servers)}
     worker_procs = {r: role_spawn("worker", r) for r in range(num_workers)}
+    serve_procs = {r: role_spawn("serve", r) for r in range(num_serve)}
     procs = {"scheduler": sched}
     procs.update({f"server-{r}": p for r, p in server_procs.items()})
     procs.update({f"worker-{r}": p for r, p in worker_procs.items()})
+    procs.update({f"serve-{r}": p for r, p in serve_procs.items()})
     threads = []
 
     def scrape_report(line: bytes) -> None:
@@ -305,6 +325,14 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
                                  daemon=True)
             m.start()
             monitors.append(m)
+    if max_serve_restarts > 0 and num_serve > 0:
+        for r in range(num_serve):
+            m = threading.Thread(target=respawn_loop,
+                                 args=("serve", "serve shard", r,
+                                       serve_procs, max_serve_restarts),
+                                 daemon=True)
+            m.start()
+            monitors.append(m)
     try:
         rc = sched.wait()
         stop_respawn.set()  # teardown begins: server exits are expected
@@ -320,7 +348,8 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
         # mid-job was replaced in worker_procs by its respawn; the dead
         # incarnation's 137 is recovery working, not job failure — but
         # the final incarnation's code always counts)
-        for p in list(worker_procs.values()) + list(server_procs.values()):
+        for p in (list(worker_procs.values()) + list(server_procs.values())
+                  + list(serve_procs.values())):
             try:
                 code = p.wait(timeout=10)
             except subprocess.TimeoutExpired:
@@ -330,6 +359,11 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
                 except subprocess.TimeoutExpired:
                     p.kill()
                     code = 1
+            if p in serve_procs.values():
+                # serving shards are infrastructure with no natural end:
+                # they exit when the scheduler goes away (or get killed
+                # here); their codes never define job success
+                continue
             if recovery and p in server_procs.values():
                 # with supervision on, a server's exit code is not the
                 # job's: an injected/real kill that recovery absorbed
@@ -365,6 +399,16 @@ def main(argv=None) -> int:
                          "(BSP allreduce apps recover it from its "
                          "version checkpoint; 0 = a worker death fails "
                          "the job)")
+    ap.add_argument("--serve", type=int, default=0, dest="num_serve",
+                    help="online serving shards to run alongside the "
+                         "job (serving/server.py): each serves its "
+                         "range of the newest snapshot set under the "
+                         "snapshot dir and hot-swaps as training "
+                         "writes newer versions")
+    ap.add_argument("--max-serve-restarts", type=int, default=0,
+                    help="respawn a dead serving shard up to N times "
+                         "per rank; routers re-resolve its new uri "
+                         "through the scheduler")
     ap.add_argument("--snapshot-dir", default=None,
                     help="directory for the servers' periodic shard "
                          "snapshots (default: a fresh temp dir when "
@@ -424,6 +468,8 @@ def main(argv=None) -> int:
                   coord_port=args.coord_port,
                   max_server_restarts=args.max_server_restarts,
                   max_worker_restarts=args.max_worker_restarts,
+                  num_serve=args.num_serve,
+                  max_serve_restarts=args.max_serve_restarts,
                   snapshot_dir=args.snapshot_dir)
 
 

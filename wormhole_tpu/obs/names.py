@@ -44,6 +44,17 @@ COUNTERS: dict[str, str] = {
     "bsp.result_fetches": "cached reduced results served to peers",
     "bsp.checkpoints": "BSP version checkpoints written",
     "bsp.checkpoint_bytes": "bytes written by BSP checkpoints",
+    "serve.requests": "predict/fetch RPCs served by a serving shard",
+    "serve.rows": "weight rows gathered for predict batches",
+    "serve.swaps": "hot snapshot swaps performed by a serving shard",
+    "serve.dedup_hits": "retried fetches answered from the reply cache",
+    "serve.router.requests": "predict batches scored through the router",
+    "serve.router.retries": "router shard-RPC retries after socket errors",
+    "serve.router.epoch_retries": "fan-outs replayed for epoch consistency",
+    "serve.router.failures": "predict batches the router gave up on",
+    "sched.serve_recoveries": "serving shards that re-registered after death",
+    "net.busy.rejections": "frames bounced by the max-in-flight gate",
+    "net.busy.retries": "client resends after a busy reply",
     "net.frames_sent": "frames written to sockets",
     "net.frames_recv": "frames read from sockets",
     "net.bytes_sent": "bytes written to sockets",
@@ -63,6 +74,7 @@ COUNTERS: dict[str, str] = {
 
 GAUGES: dict[str, str] = {
     "ps.server.restore_epoch": "epoch a shard last restored from",
+    "serve.model_epoch": "active snapshot version on a serving shard",
     "ps.sync.inflight": "async sync rounds currently in flight (0/1)",
     "ps.sync.overlap_frac": "fraction of sync wall time hidden by compute",
     "queue.depth": "loader output queue depth",
@@ -73,6 +85,9 @@ GAUGES: dict[str, str] = {
 
 HISTOGRAMS: dict[str, str] = {
     "ps.server.snapshot_s": "shard snapshot write duration",
+    "serve.op.*_s": "per-op serving-shard handler duration",
+    "serve.latency_s": "router-side end-to-end predict batch latency",
+    "serve.swap_stall_s": "request-visible pause while flipping snapshots",
     "ps.server.op.*_s": "per-op PS server handler duration",
     "ps.client.rpc_s": "single client RPC round-trip",
     "ps.client.sync_push_s": "push half of a sync round",
@@ -103,9 +118,11 @@ SPANS: dict[str, str] = {
 
 EVENTS: dict[str, str] = {
     "ps.restore": "server shard restored from snapshot",
+    "serve.swap": "serving shard flipped to a newer snapshot version",
     "ps.rollback": "client detected server epoch rollback",
     "ps.reconnect": "client reconnected to a respawned server",
     "sched.server_recovered": "scheduler accepted a server re-registration",
+    "sched.serve_recovered": "scheduler accepted a serving-shard re-registration",
     "sched.bsp_recovered": "scheduler accepted a BSP worker re-registration",
     "sched.liveness_evict": "scheduler evicted an unresponsive node",
 }

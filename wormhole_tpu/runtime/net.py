@@ -35,9 +35,11 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import random
 import socket
 import struct
+import threading
 import time
 import zlib
 from typing import Optional
@@ -62,6 +64,58 @@ _DECODE_S = _obs.REGISTRY.histogram("net.decode_s")
 # directions, so the run report can state the codec's measured effect
 _COMPRESS_OUT = _obs.REGISTRY.counter("net.compress.bytes_out")
 _COMPRESS_IN = _obs.REGISTRY.counter("net.compress.bytes_in")
+_BUSY_REJECTIONS = _obs.REGISTRY.counter("net.busy.rejections")
+_BUSY_RETRIES = _obs.REGISTRY.counter("net.busy.retries")
+
+
+class InflightGate:
+    """Server-side admission gate: at most WH_NET_MAX_INFLIGHT requests
+    may be in their handler concurrently; the overflow gets a structured
+    `busy` reply (see `busy_reply`) instead of queueing behind a
+    saturated thread pool. 0 (the default) admits everything — existing
+    PS deployments see no behavior change unless they opt in. The knob
+    is read once at server construction; per-request cost at the default
+    is a single None check."""
+
+    def __init__(self, limit: Optional[int] = None):
+        if limit is None:
+            limit = int(os.environ.get("WH_NET_MAX_INFLIGHT", "0") or 0)
+        self.limit = max(int(limit), 0)
+        self._sem = (threading.BoundedSemaphore(self.limit)
+                     if self.limit else None)
+
+    def try_enter(self) -> bool:
+        """Admit one request; False means the caller must send
+        `busy_reply()` and NOT dispatch (and must not `leave()`)."""
+        if self._sem is None:
+            return True
+        ok = self._sem.acquire(blocking=False)
+        if not ok:
+            _BUSY_REJECTIONS.inc()
+        return ok
+
+    def leave(self) -> None:
+        if self._sem is not None:
+            self._sem.release()
+
+
+def busy_reply(retry_ms: float = 25.0) -> dict:
+    """Header of the structured backpressure reply. Not an `error`:
+    nothing was dispatched, the client should back off `retry_ms`
+    (jittered) and resend the SAME frame — for seq-fenced ops the fence
+    stamp is reused, so the eventual apply is still exactly-once."""
+    return {"busy": 1, "retry_ms": float(retry_ms)}
+
+
+def busy_backoff(header: dict) -> bool:
+    """Client side of the gate: True when `header` is a busy reply, after
+    sleeping its (jittered) hint — the caller just retries its frame."""
+    if not header.get("busy"):
+        return False
+    _BUSY_RETRIES.inc()
+    hint = float(header.get("retry_ms", 25.0)) / 1000.0
+    time.sleep(hint * (0.5 + random.random()))
+    return True
 
 
 def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,

@@ -77,7 +77,8 @@ from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime.net import (  # noqa: F401  (re-exported: the wire
-    _COMPRESS_MIN, _decode, _encode, _read_exact, connect_with_retry,
+    _COMPRESS_MIN, _decode, _encode, _read_exact, InflightGate,
+    busy_backoff, busy_reply, connect_with_retry,
     key_digest, recv_frame, send_frame)  # format moved to net.py so fault
 # injection can hook frame send/recv for every net user; tests and tools
 # keep importing the names from here.
@@ -168,7 +169,18 @@ class _PSHandler(socketserver.StreamRequestHandler):
             if got is None:
                 return
             header, arrays, _ = got
-            resp_header, resp_arrays = node._dispatch(header, arrays)
+            # backpressure gate (WH_NET_MAX_INFLIGHT): an over-admitted
+            # frame is bounced with a structured busy reply BEFORE
+            # dispatch — nothing was applied, so the client's resend of
+            # the same seq-stamped frame stays exactly-once
+            if not node._gate.try_enter():
+                send_frame(self.wfile, dict(busy_reply(),
+                                            epoch=node.epoch))
+                continue
+            try:
+                resp_header, resp_arrays = node._dispatch(header, arrays)
+            finally:
+                node._gate.leave()
             if (header.get("op") == "hello" and header.get("net_compress")
                     and node.net_compress):
                 fc = True
@@ -286,6 +298,9 @@ class ServerNode:
         # meant for the hot plane's cold-tier traffic — big, rare flush
         # frames — where the codec cost amortizes; default off
         self.net_compress = _env_flag("WH_NET_COMPRESS")
+        # max-in-flight admission gate (WH_NET_MAX_INFLIGHT; default
+        # unlimited = a single None check per frame)
+        self._gate = InflightGate()
         self._srv = _PSServer((host, port), _PSHandler)
         self._srv.node = self  # type: ignore
         self.num_push = 0
@@ -919,6 +934,7 @@ class ServerNode:
         return path
 
     def _snapshot_impl(self) -> Optional[str]:
+        from wormhole_tpu.utils import manifest as _manifest
         from wormhole_tpu.utils.checkpoint import atomic_savez, part_name
 
         with self._lock:
@@ -938,11 +954,19 @@ class ServerNode:
                 "zero_flags": self._zero_flags,
             }
             clock = self.clock
+            full_rows = dict(self.full_rows)
         arrays["__snap__"] = np.frombuffer(
             json.dumps(meta).encode(), np.uint8).copy()
-        path = part_name(self._snap_base or "ps_snap", None,
-                         self.rank) + ".npz"
+        base = self._snap_base or "ps_snap"
+        path = part_name(base, None, self.rank) + ".npz"
         atomic_savez(path, compressed=True, **arrays)
+        # publish the finished part in the snapshot-set manifest so
+        # readers (restore on a respawn, the serving watcher) discover a
+        # digest-verified consistent set instead of globbing — closing
+        # the torn-read window where a reader pairs this rank's fresh
+        # part with a half-replaced peer's
+        _manifest.update_manifest(base, self.rank, self.world, path,
+                                  clock, self.epoch, full_rows)
         # only advance the skip-fence after the write landed; re-take the
         # lock because restore_snapshot writes it from the serving threads
         with self._lock:
@@ -957,13 +981,32 @@ class ServerNode:
         pulling with a pre-crash `since` below it receives every row the
         snapshot knows (a superset of what it missed — over-delivery is
         safe, under-delivery would desync the base mirror)."""
+        from wormhole_tpu.utils import manifest as _manifest
         from wormhole_tpu.utils.checkpoint import part_name
 
         self._snap_base = base
         path = part_name(base, None, self.rank) + ".npz"
-        if not os.path.exists(path):
-            return False
-        got = dict(np.load(path))
+        got = None
+        # manifest-first: read the digest-verified part the manifest
+        # names (a peer may be mid-replace — retry a couple of times on
+        # a torn read, each time against a fresh manifest)
+        man = _manifest.read_manifest(base)
+        if man is not None and str(self.rank) in man.get("parts", {}):
+            for _ in range(3):
+                try:
+                    got = _manifest.read_part(base, man, self.rank)
+                    break
+                except _manifest.TornSnapshot as e:
+                    print(f"[ps server {self.rank}] torn snapshot read "
+                          f"({e}); retrying", flush=True)
+                    time.sleep(0.05)
+                    man = _manifest.read_manifest(base) or man
+        if got is None:
+            # pre-manifest snapshot dirs (or a manifest that never saw
+            # this rank): fall back to the direct part path
+            if not os.path.exists(path):
+                return False
+            got = dict(np.load(path))
         meta = json.loads(bytes(got.pop("__snap__").tobytes()).decode())
         with self._lock:
             self.tables = {k: np.ascontiguousarray(v, np.float32)
@@ -1148,10 +1191,22 @@ class PSClient:
             header = dict(header, sender=self.sender, seq=self._seq[r])
         t_rpc = time.monotonic()
         recovered = False
+        # a saturated server (WH_NET_MAX_INFLIGHT) answers `busy` without
+        # dispatching; resending the same stamped frame is exactly-once,
+        # so just back off and retry — bounded so a wedged server still
+        # fails loudly instead of spinning forever
+        busy_deadline = t_rpc + max(self.retry_deadline, 60.0)
         while True:
             try:
                 h, arrs, sent, received = self._attempt(
                     r, header, arrays, fixed_bytes, compress)
+                if busy_backoff(h):
+                    if time.monotonic() >= busy_deadline:
+                        raise RuntimeError(
+                            f"ps server {self.uris[r]} still busy after "
+                            f"{time.monotonic() - t_rpc:.0f}s of backoff "
+                            f"during '{op_name}'")
+                    continue
                 break
             except OSError as e:
                 self.close(r)

@@ -42,6 +42,7 @@ from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
 
 _EVICTIONS = _obs.REGISTRY.counter("sched.liveness_evictions")
 _SRV_RECOVERIES = _obs.REGISTRY.counter("sched.server_recoveries")
+_SERVE_RECOVERIES = _obs.REGISTRY.counter("sched.serve_recoveries")
 _BSP_RECOVERIES = _obs.REGISTRY.counter("bsp.recoveries")
 _BARRIER_WAIT_S = _obs.REGISTRY.histogram("sched.barrier_wait_s")
 
@@ -50,6 +51,7 @@ class Role(str, Enum):
     SCHEDULER = "scheduler"
     WORKER = "worker"
     SERVER = "server"
+    SERVE = "serve"  # online serving shard (serving/server.py)
 
 
 @dataclasses.dataclass
@@ -63,6 +65,7 @@ class NodeEnv:
     num_servers: int
     scheduler_uri: str
     coord_uri: str = ""  # jax.distributed coordinator (global-mesh mode)
+    num_serve: int = 0   # online serving shards (--serve group)
 
     @property
     def is_distributed(self) -> bool:
@@ -78,6 +81,7 @@ def node_env() -> NodeEnv:
         num_servers=int(os.environ.get("WH_NUM_SERVERS", "1")),
         scheduler_uri=os.environ.get("WH_SCHEDULER_URI", ""),
         coord_uri=os.environ.get("WH_COORD_URI", ""),
+        num_serve=int(os.environ.get("WH_NUM_SERVE", "0")),
     )
 
 
@@ -114,6 +118,8 @@ class Scheduler:
         self.node_timeout = node_timeout
         self.num_servers = num_servers
         self._server_uris: dict[int, str] = {}   # ps server rank -> uri
+        self._serve_uris: dict[int, str] = {}    # serving shard rank -> uri
+        self.num_serve_recoveries = 0            # shards that re-registered
         self._bsp_uris: dict[int, str] = {}      # bsp worker rank -> uri
         self._bsp_gen = 0                        # membership generation
         self.num_bsp_recoveries = 0              # workers that re-registered
@@ -330,6 +336,36 @@ class Scheduler:
                 print(f"[recovery] ps server-{rank} re-registered at "
                       f"{req['uri']} (was {prev})", flush=True)
             return {"ok": True}
+        if op == "register_serve":
+            # a serving shard announces its predict endpoint. A rank
+            # re-registering under a NEW uri is a respawned shard
+            # rejoining after death — routers following the serve_nodes
+            # resolver pick the new address up on their next retry.
+            with self._lock:
+                rank = int(req["rank"])
+                prev = self._serve_uris.get(rank)
+                self._serve_uris[rank] = req["uri"]
+                recovered = prev is not None and prev != req["uri"]
+                if recovered:
+                    self.num_serve_recoveries += 1
+                    self.progress.merge({"serve_recoveries": 1.0})
+            if recovered:
+                _SERVE_RECOVERIES.inc()
+                _trace.event("sched.serve_recovered", cat="recovery",
+                             rank=rank, uri=req["uri"], prev=prev)
+                print(f"[recovery] serve shard-{rank} re-registered at "
+                      f"{req['uri']} (was {prev})", flush=True)
+            return {"ok": True}
+        if op == "serve_nodes":
+            # routers poll until the full --serve group is up, and
+            # re-poll after a socket error to chase a respawned shard
+            world = int(req.get("world", 0))
+            with self._lock:
+                known = len(self._serve_uris)
+                ready = known >= world > 0
+                uris = [self._serve_uris[r]
+                        for r in sorted(self._serve_uris)] if ready else []
+            return {"ready": ready, "uris": uris, "num_known": known}
         if op == "register_bsp":
             # a BSP worker announces its ring endpoint. A rank
             # re-registering under a NEW uri is a respawned worker

@@ -1,0 +1,28 @@
+"""Online serving tier: predict-as-a-service over training snapshots.
+
+Shards (``ModelServer``) each hold one ``shard_range`` slice of a
+``write_snapshot_set``/ps_server snapshot set, hot-swap to newer
+versions the moment the manifest says they are complete, and answer
+row-fetch RPCs.  A ``Router`` fans a batch's unique keys out over the
+shards and scores on the reassembled compact tables with a model
+scorer — bit-identical to the trainer's own predict path.
+"""
+
+from wormhole_tpu.serving.router import Router
+from wormhole_tpu.serving.scoring import (
+    DifactoScorer, LinearScorer, PackedBatch,
+)
+from wormhole_tpu.serving.server import (
+    ModelServer, ServingModel, load_with_retry, run_serve_role,
+)
+
+__all__ = [
+    "DifactoScorer",
+    "LinearScorer",
+    "ModelServer",
+    "PackedBatch",
+    "Router",
+    "ServingModel",
+    "load_with_retry",
+    "run_serve_role",
+]

@@ -1,0 +1,285 @@
+"""Predict router: fan a batch's unique keys out over the serving shards.
+
+The router is the client half of the serving tier: it packs a RowBlock
+with a scorer (serving/scoring.py), splits each table's sorted-unique
+key list into the per-shard contiguous ranges of the same even
+``shard_range`` split the shards loaded, fetches every shard's rows in
+parallel, and scores on the reassembled compact tables — bit-identical
+to the trainer's own predict (the scorer's contract).
+
+Consistency: every shard reply carries the model ``version`` its rows
+came from. A hot swap landing mid-fan-out can hand back a mixed set;
+the router detects the mismatch and replays the whole fan-out
+(serve.router.epoch_retries) until the versions agree — a scored batch
+is always computed from ONE snapshot version, which rides back to the
+caller.
+
+Fault tolerance: shard RPCs ride stable per-connection sender ids with
+monotone sequence numbers. A socket error inside the retry window
+(WH_SERVE_RETRY_SEC) re-resolves the shard's uri (a respawned shard
+re-registers with the scheduler; the resolver picks the new address
+up), redials, and resends the SAME seq — the shard's reply cache
+returns the original reply when the first send actually landed, so a
+retried fetch can never straddle two versions. Busy bounces
+(WH_NET_MAX_INFLIGHT) back off and resend on the same connection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from wormhole_tpu.config import knob_value
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.runtime.net import (
+    busy_backoff, connect_with_retry, recv_frame, send_frame,
+)
+from wormhole_tpu.utils.manifest import shard_range
+
+_ROUTER_REQUESTS = _obs.REGISTRY.counter("serve.router.requests")
+_ROUTER_RETRIES = _obs.REGISTRY.counter("serve.router.retries")
+_EPOCH_RETRIES = _obs.REGISTRY.counter("serve.router.epoch_retries")
+_FAILURES = _obs.REGISTRY.counter("serve.router.failures")
+_LATENCY_S = _obs.REGISTRY.histogram("serve.latency_s")
+
+_EPOCH_REPLAYS = 8  # fan-out replays before a mixed-version batch fails
+
+
+class _Slot:
+    """One pooled shard connection with a STABLE sender identity: the
+    seq counter survives redials, so a retried frame after a reconnect
+    reuses its seq and hits the shard's reply cache."""
+
+    def __init__(self, sender: str):
+        self.sender = sender
+        self.seq = 0
+        self.sock = None
+        self.f = None
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.sock = None
+        self.f = None
+
+
+class Router:
+    """Thread-safe fan-out/merge client over a serving shard group."""
+
+    def __init__(self, uris: List[str], scorer, sender: str = "router",
+                 retry_deadline: Optional[float] = None,
+                 resolver: Optional[Callable[[], Optional[List[str]]]] = None,
+                 connect_deadline: float = 10.0):
+        self.scorer = scorer
+        self.sender = sender
+        self.resolver = resolver
+        self.retry_deadline = (float(knob_value("WH_SERVE_RETRY_SEC"))
+                               if retry_deadline is None
+                               else float(retry_deadline))
+        self.connect_deadline = connect_deadline
+        self._lock = threading.Lock()
+        self._uris = list(uris)  # wormlint: guarded-by(self._lock)
+        self.world = len(uris)
+        self._free: Dict[int, list] = {r: [] for r in range(self.world)}
+        self._slot_ids = 0  # wormlint: guarded-by(self._lock)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(8, 2 * self.world),
+            thread_name_prefix="serve-router")
+        # one hello up front: table row counts drive the key split, and
+        # a shard configured for a different world would shard-range
+        # differently than this router splits
+        hello = self._rpc(0, {"op": "hello"}, {})[0]
+        if int(hello["world"]) != self.world:
+            raise RuntimeError(
+                f"shard 0 serves world={hello['world']} but the router "
+                f"was given {self.world} uris")
+        self.full_rows = {k: int(v)
+                          for k, v in hello["full_rows"].items()}
+
+    @staticmethod
+    def from_scheduler(client, scorer, world: int,
+                       timeout: float = 60.0, **kw) -> "Router":
+        """Build against a scheduler's registered ``--serve`` group; the
+        resolver keeps following re-registrations (shard respawns)."""
+
+        def resolve() -> Optional[List[str]]:
+            try:
+                got = client.call(op="serve_nodes", world=world)
+                return got["uris"] if got.get("ready") else None
+            except Exception:
+                return None
+
+        deadline = time.monotonic() + timeout
+        uris = resolve()
+        while not uris:
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"serve group never fully registered ({world} shards)")
+            time.sleep(0.2)
+            uris = resolve()
+        return Router(uris, scorer, resolver=resolve, **kw)
+
+    # -- connection pool ----------------------------------------------------
+    def _acquire(self, r: int) -> _Slot:
+        with self._lock:
+            if self._free[r]:
+                return self._free[r].pop()
+            self._slot_ids += 1
+            return _Slot(f"{self.sender}:{r}:{self._slot_ids}")
+
+    def _release(self, r: int, slot: _Slot) -> None:
+        with self._lock:
+            self._free[r].append(slot)
+
+    def _dial(self, slot: _Slot, r: int) -> None:
+        # short per-attempt deadline: a dead shard's old port must fail
+        # fast so the outer retry loop re-consults the resolver (which
+        # is where a respawned shard's NEW uri shows up) instead of
+        # burning the whole budget dialing a port nobody listens on
+        with self._lock:
+            uri = self._uris[r]
+        host, port = uri.rsplit(":", 1)
+        slot.sock = connect_with_retry((host, int(port)),
+                                       min(self.connect_deadline, 1.0))
+        slot.f = slot.sock.makefile("rwb")
+
+    def _refresh_uris(self) -> None:
+        if self.resolver is None:
+            return
+        got = self.resolver()
+        if got and len(got) == self.world:
+            with self._lock:
+                self._uris = list(got)
+
+    # -- RPC ----------------------------------------------------------------
+    def _rpc(self, r: int, header: dict,
+             arrays: Dict[str, np.ndarray]) -> tuple[dict, dict]:
+        slot = self._acquire(r)
+        try:
+            hdr = dict(header, sender=slot.sender, seq=slot.seq)
+            slot.seq += 1
+            deadline = time.monotonic() + max(self.retry_deadline, 0.0)
+            while True:
+                try:
+                    if slot.f is None:
+                        self._dial(slot, r)
+                    send_frame(slot.f, hdr, arrays)
+                    while True:
+                        got = recv_frame(slot.f)
+                        if got is None:
+                            raise ConnectionResetError(
+                                f"serve shard {r} closed the connection")
+                        reply, rarr, _ = got
+                        if busy_backoff(reply):
+                            # bounced before dispatch: resend the same
+                            # seq-stamped frame after the jittered hint
+                            send_frame(slot.f, hdr, arrays)
+                            continue
+                        break
+                    if "error" in reply:
+                        raise RuntimeError(
+                            f"serve shard {r}: {reply['error']}")
+                    return reply, rarr
+                except (OSError, ConnectionError):
+                    slot.close()
+                    if time.monotonic() >= deadline:
+                        raise
+                    _ROUTER_RETRIES.inc()
+                    # a respawned shard re-registered under a new uri;
+                    # the resolver hands it to the next dial
+                    self._refresh_uris()
+                    time.sleep(0.1)
+        finally:
+            self._release(r, slot)
+
+    # -- fan-out ------------------------------------------------------------
+    def _split(self, keys: np.ndarray, rows: int) -> List[slice]:
+        """Per-shard contiguous slices of a sorted key vector under the
+        even split (keys are sorted, so each shard's keys are one run)."""
+        out = []
+        for r in range(self.world):
+            lo, hi = shard_range(rows, r, self.world)
+            a, b = np.searchsorted(keys, [lo, hi])
+            out.append(slice(int(a), int(b)))
+        return out
+
+    def _fanout(self, packed) -> tuple[Dict[str, np.ndarray], int]:
+        """One fetch round: returns (rows per table, model version) or
+        raises on a mixed-version set (caller replays)."""
+        tables = list(self.scorer.tables)
+        splits = {t: self._split(packed.keys[t], self.full_rows[t])
+                  for t in tables}
+        jobs = []  # (rank, tables present, key arrays)
+        for r in range(self.world):
+            present = [t for t in tables
+                       if splits[t][r].stop > splits[t][r].start]
+            if not present:
+                continue
+            arrays = {f"k:{t}": packed.keys[t][splits[t][r]]
+                      for t in present}
+            jobs.append((r, present, arrays))
+        futs = [self._pool.submit(
+            self._rpc, r, {"op": "fetch", "tables": present}, arrays)
+            for r, present, arrays in jobs]
+        got = [f.result() for f in futs]
+        versions = {int(reply["version"]) for reply, _ in got}
+        if len(versions) > 1:
+            raise _MixedVersions(versions)
+        pieces: Dict[str, list] = {t: [] for t in tables}
+        for (_, present, _), (_, rarr) in zip(jobs, got):
+            for t in present:
+                pieces[t].append(np.asarray(rarr[f"r:{t}"]))
+        rows = {t: (p[0] if len(p) == 1 else np.concatenate(p))
+                for t, p in pieces.items()}
+        return rows, versions.pop()
+
+    def predict_block(self, blk) -> tuple[np.ndarray, int]:
+        """Score one RowBlock; returns (scores[:size], model version).
+        The scores are guaranteed to come from ONE snapshot version."""
+        t0 = time.perf_counter()
+        packed = self.scorer.pack(blk)
+        try:
+            for attempt in range(_EPOCH_REPLAYS):
+                try:
+                    rows, version = self._fanout(packed)
+                except _MixedVersions:
+                    # a hot swap landed mid-fan-out; replay against the
+                    # (now uniform) new version. Shard watchers can be
+                    # skewed by up to their poll interval, so back off
+                    # exponentially until the replays span at least one
+                    # full WH_SERVE_POLL_SEC — immediate replays would
+                    # all burn inside the skew window
+                    _EPOCH_RETRIES.inc()
+                    poll = float(knob_value("WH_SERVE_POLL_SEC"))
+                    time.sleep(min(0.01 * (2 ** attempt), max(poll, 0.01)))
+                    continue
+                scores = self.scorer.score(packed, rows)
+                _ROUTER_REQUESTS.inc()
+                _LATENCY_S.observe(time.perf_counter() - t0)
+                return scores, version
+            raise RuntimeError(
+                f"shard versions never agreed after {_EPOCH_REPLAYS} "
+                "fan-out replays")
+        except Exception:
+            _FAILURES.inc()
+            raise
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        with self._lock:
+            slots = [s for free in self._free.values() for s in free]
+            for free in self._free.values():
+                free.clear()
+        for s in slots:
+            s.close()
+
+
+class _MixedVersions(Exception):
+    """Fan-out replies spanned a hot swap (internal replay signal)."""

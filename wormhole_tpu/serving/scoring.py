@@ -1,0 +1,173 @@
+"""Model scorers: predict math over compact row sets, bit-matched to
+training.
+
+A serving shard holds only its row range, so the router cannot run the
+learner's full-table ``predict_step``. Instead each scorer packs a
+RowBlock exactly the way the trainer's CPU/XLA path does
+(``to_device_batch`` — identical seg/val arrays, identical padding),
+collects the batch's sorted-unique keys per table, and scores over a
+COMPACT table whose rows were gathered from the shards. Because the
+compact remap satisfies ``compact[remap[j]] == full[idx[j]]`` row for
+row, every elementwise product and the ``segment_sum`` reduction see
+the SAME float operands in the SAME order as the trainer's jitted
+``spmv``/``_fm_forward`` — so the margins are bit-identical to the
+model owner's own ``predict_batch`` (the serving tier's correctness
+contract; tests/test_serving.py asserts equality, not closeness).
+
+The contract is against the trainer's SINGLE-DEVICE program: a trainer
+predicting through a data-sharded mesh compiles a different (equally
+valid) XLA program whose fusion/reassociation can move individual
+margins by an ulp. Scores are deterministic either way — the scorer is
+one fixed program — but "bit-identical to the trainer" means the 1x1
+mesh path.
+
+Compact tables are zero-padded up to a power-of-two capacity so the
+jitted kernels compile O(log capacity) times, not once per batch shape;
+padded rows are never indexed by the remap, so their contents cannot
+perturb the result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
+from wormhole_tpu.ops.spmv import row_squares, spmm, spmv
+
+_MIN_CAP = 256
+
+
+def _cap(n: int) -> int:
+    """Power-of-two compact-table capacity (bounded jit-cache growth)."""
+    return max(_MIN_CAP, 1 << max(int(n) - 1, 0).bit_length())
+
+
+def _padded(rows: np.ndarray, cap: int) -> np.ndarray:
+    out = np.zeros((cap,) + rows.shape[1:], np.float32)
+    out[: len(rows)] = rows
+    return out
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """One RowBlock, packed for sharded scoring: the fixed-shape COO
+    arrays (trainer-identical), the sorted-unique key list each table's
+    rows must be fetched for, and the compact remaps per key space."""
+
+    seg: np.ndarray                    # int32[capacity]
+    val: np.ndarray                    # float32[capacity]
+    size: int                          # live rows (score rows returned)
+    keys: Dict[str, np.ndarray]        # table -> sorted-unique int64 keys
+    remap: Dict[str, np.ndarray]       # key space -> int32[capacity]
+    dropped_rows: int = 0
+
+
+@partial(jax.jit, static_argnames=("num_rows",))
+def _linear_margin(seg, idxc, val, w, *, num_rows: int):
+    return spmv(seg, idxc, val, w, num_rows)
+
+
+@partial(jax.jit, static_argnames=("num_rows", "threshold", "l1_shrk"))
+def _fm_margin(seg, idxc, vidxc, val, w, cnt, V, *,
+               num_rows: int, threshold: int, l1_shrk: bool):
+    # mirror of models/difacto._fm_forward over the compact domain: the
+    # admission mask, both quadratic terms, and the reduction order are
+    # operand-for-operand the trainer's
+    admit = cnt >= threshold
+    if l1_shrk:
+        admit = admit & (w != 0)
+    admit_nz = jnp.take(admit.astype(jnp.float32), idxc)
+    xw = spmv(seg, idxc, val, w, num_rows)
+    vval = val * admit_nz
+    xv = spmm(seg, vidxc, vval, V, num_rows)
+    x2v2 = row_squares(seg, vidxc, vval, V, num_rows)
+    return xw + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
+
+
+class LinearScorer:
+    """Margins for the linear apps: serves ``w`` only. ``cfg`` is a
+    LinearConfig (or anything with minibatch/row_capacity/num_buckets/
+    prob_predict)."""
+
+    #: tables fetched from the shards, and the key space each indexes
+    tables = ("w",)
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def pack(self, blk: RowBlock) -> PackedBatch:
+        cfg = self.cfg
+        db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
+                             cfg.num_buckets)
+        uniq, idxc = np.unique(db.idx, return_inverse=True)
+        return PackedBatch(
+            seg=db.seg, val=db.val,
+            size=min(blk.size, cfg.minibatch) - db.dropped_rows,
+            keys={"w": uniq.astype(np.int64)},
+            remap={"w": idxc.astype(np.int32)},
+            dropped_rows=db.dropped_rows)
+
+    def score(self, packed: PackedBatch,
+              rows: Dict[str, np.ndarray]) -> np.ndarray:
+        cap = _cap(len(packed.keys["w"]))
+        xw = _linear_margin(
+            jnp.asarray(packed.seg), jnp.asarray(packed.remap["w"]),
+            jnp.asarray(packed.val), jnp.asarray(_padded(rows["w"], cap)),
+            num_rows=self.cfg.minibatch)
+        out = np.asarray(xw)[: packed.size]
+        if getattr(self.cfg, "prob_predict", False):
+            out = 1.0 / (1.0 + np.exp(-out))
+        return out
+
+
+class DifactoScorer:
+    """FM margins for the difacto app: serves ``w``/``cnt`` (bucket key
+    space) and ``V`` (embedding key space, ``key % vb``). Admission is
+    recomputed from the served ``cnt`` rows exactly as the trainer's
+    forward does, so a never-admitted bucket scores as unallocated."""
+
+    tables = ("w", "cnt", "V")
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def pack(self, blk: RowBlock) -> PackedBatch:
+        cfg = self.cfg
+        db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
+                             cfg.num_buckets)
+        vidx = (db.idx % np.int32(cfg.vb)).astype(np.int32)
+        uniq_w, idxc = np.unique(db.idx, return_inverse=True)
+        uniq_v, vidxc = np.unique(vidx, return_inverse=True)
+        uniq_w = uniq_w.astype(np.int64)
+        uniq_v = uniq_v.astype(np.int64)
+        return PackedBatch(
+            seg=db.seg, val=db.val,
+            size=min(blk.size, cfg.minibatch) - db.dropped_rows,
+            keys={"w": uniq_w, "cnt": uniq_w, "V": uniq_v},
+            remap={"w": idxc.astype(np.int32),
+                   "V": vidxc.astype(np.int32)},
+            dropped_rows=db.dropped_rows)
+
+    def score(self, packed: PackedBatch,
+              rows: Dict[str, np.ndarray]) -> np.ndarray:
+        cfg = self.cfg
+        cap_w = _cap(len(packed.keys["w"]))
+        cap_v = _cap(len(packed.keys["V"]))
+        margin = _fm_margin(
+            jnp.asarray(packed.seg), jnp.asarray(packed.remap["w"]),
+            jnp.asarray(packed.remap["V"]), jnp.asarray(packed.val),
+            jnp.asarray(_padded(rows["w"], cap_w)),
+            jnp.asarray(_padded(rows["cnt"], cap_w)),
+            jnp.asarray(_padded(rows["V"], cap_v)),
+            num_rows=cfg.minibatch, threshold=int(cfg.threshold),
+            l1_shrk=bool(cfg.l1_shrk))
+        out = np.asarray(margin)[: packed.size]
+        if getattr(cfg, "prob_predict", False):
+            out = 1.0 / (1.0 + np.exp(-out))
+        return out
