@@ -618,18 +618,53 @@ def bench_serve(num_shards=2, num_buckets=1 << 26, duration_s=12.0):
     its bench operating point). The window is sized so a full 256 MB
     set write (~2 s) + the watcher's slice load lands well inside it —
     a 6 s run clocked zero in-window swaps."""
-    from tools.serve_lab import run as serve_run
+    import os
+    import shutil
+    import tempfile
 
-    return serve_run(num_shards=num_shards, num_buckets=num_buckets,
-                     minibatch=1000, nnz=64, duration_s=duration_s,
-                     concurrency=4, swap_every_s=2.0,
-                     verbose=False)
+    from tools.serve_lab import run as serve_run
+    from wormhole_tpu.obs import trace as obs_trace
+
+    row = serve_run(num_shards=num_shards, num_buckets=num_buckets,
+                    minibatch=1000, nnz=64, duration_s=duration_s,
+                    concurrency=4, swap_every_s=2.0,
+                    verbose=False)
+    # price the tracing plane: the same load with spans sampled 1 in 64
+    # into a scratch WH_OBS_DIR, vs the tracing-off run above. The
+    # overhead lands in the row so a regression shows up as a number.
+    obs_dir = tempfile.mkdtemp(prefix="wh_bench_obs_")
+    saved = {k: os.environ.get(k) for k in ("WH_OBS_DIR",
+                                            "WH_TRACE_SAMPLE")}
+    os.environ["WH_OBS_DIR"] = obs_dir
+    os.environ["WH_TRACE_SAMPLE"] = "64"
+    obs_trace.init_from_env()
+    try:
+        traced = serve_run(num_shards=num_shards, num_buckets=num_buckets,
+                           minibatch=1000, nnz=64, duration_s=duration_s,
+                           concurrency=4, swap_every_s=2.0,
+                           seed=1, verbose=False)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        obs_trace.init_from_env()
+        shutil.rmtree(obs_dir, ignore_errors=True)
+    row["qps_traced_1_in_64"] = round(traced["qps"], 1)
+    row["obs_overhead_pct"] = round(
+        (1.0 - traced["qps"] / row["qps"]) * 100.0, 2) if row["qps"] \
+        else None
+    return row
 
 
 def emit_serve():
     row = _safe("serve", bench_serve)
     if row is None:
         return
+    stage_kw = {f"{st}_ms": row[f"{st}_ms"]
+                for st in ("pack", "fanout", "wire", "queue", "score",
+                           "sum") if row.get(f"{st}_ms") is not None}
     emit("linear_ftrl_serve_64m_buckets", round(row["qps"], 1), "qps",
          p50_ms=round(row["p50_ms"], 3), p99_ms=round(row["p99_ms"], 3),
          p999_ms=round(row["p999_ms"], 3),
@@ -637,7 +672,11 @@ def emit_serve():
          requests=row["requests"], errors=row["errors"],
          swap_count=row["swap_count"],
          swap_stall_ms=round(row["swap_stall_ms"], 3),
-         epoch_retries=row["epoch_retries"])
+         epoch_retries=row["epoch_retries"],
+         stage_explained_frac=row.get("stage_explained_frac"),
+         qps_traced_1_in_64=row.get("qps_traced_1_in_64"),
+         obs_overhead_pct=row.get("obs_overhead_pct"),
+         **stage_kw)
 
 
 def _safe(what, fn, *args, **kw):

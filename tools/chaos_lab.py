@@ -221,6 +221,27 @@ def metric_deltas(m: dict[str, int], base: dict[str, int],
                     if m[k] - base[k] != 0) or "Δ(none)"
 
 
+def slo_burn_line(report: dict | None) -> str:
+    """One line of SLO burn rates from the run report (obs/slo.py).
+    Latency burns are informational under fault injection — slowness is
+    the point — but error-budget burns feed the verdict."""
+    slos = (report or {}).get("slos") or []
+    if not slos:
+        return "slo: (none evaluated)"
+    return "slo burn: " + " ".join(
+        f"{v['name']}={v['burn']:g}{'' if v['ok'] else '!'}"
+        for v in slos)
+
+
+def slo_error_violation(report: dict | None) -> str | None:
+    """Name of a violated error-kind SLO, if any. Latency SLOs are
+    exempt here: injected delay/kills legitimately spike tails."""
+    for v in (report or {}).get("slos") or []:
+        if v.get("kind") == "errors" and not v.get("ok"):
+            return v["name"]
+    return None
+
+
 def fault_fired(out: str) -> bool:
     """Did the injected fault actually trigger? Matches the arm/fire
     lines of every faults.py family: net injections, server kills, and
@@ -331,11 +352,14 @@ def bsp_matrix(args) -> int:
                     worst = max(worst, 3)
                 else:
                     verdict, detail = "survived", why
+                    bad_slo = slo_error_violation(report)
                     if is_kill and not fault_fired(out):
                         verdict = "survived (fault never fired!)"
                     elif is_kill and report is not None \
                             and m["bsp_recoveries"] < 1:
                         verdict = "survived (no recovery observed!)"
+                    elif bad_slo:
+                        verdict = f"survived ({bad_slo} SLO violated!)"
             recov = len(re.findall(r"respawning with restore epoch", out))
             deltas = metric_deltas(m, base_m, _BSP_METRIC_KEYS) \
                 if report is not None else "(no run_report.json)"
@@ -345,6 +369,7 @@ def bsp_matrix(args) -> int:
                   f"({detail.splitlines()[0]}, {recov} respawns, "
                   f"{dt:.0f}s)")
             print(f"[chaos]   metrics vs baseline: {deltas}")
+            print(f"[chaos]   {slo_burn_line(report)}")
 
     print(f"\n{'spec':<42} {'verdict':<30} {'respawns':>8} {'sec':>5}")
     for spec, verdict, detail, recov, dt, deltas in rows:
@@ -520,6 +545,9 @@ max_delay = 1
                 # invalidates means stale digests could resolve to the
                 # wrong key list after a respawn
                 verdict = "survived (keycache never invalidated!)"
+            elif slo_error_violation(report):
+                verdict = (f"survived ({slo_error_violation(report)} "
+                           "SLO violated!)")
         recov = len(re.findall(r"respawning with restore epoch", out))
         retries = len(re.findall(r"\[ps-retry\]", out))
         deltas = metric_deltas(m, base_m) if report is not None \
@@ -528,6 +556,7 @@ max_delay = 1
         print(f"[chaos] {spec}: {verdict} ({detail.splitlines()[0]}, "
               f"{recov} respawns, {retries} retry events, {dt:.0f}s)")
         print(f"[chaos]   metrics vs baseline: {deltas}")
+        print(f"[chaos]   {slo_burn_line(report)}")
 
     print(f"\n{'spec':<34} {'verdict':<18} {'respawns':>8} "
           f"{'retries':>8} {'sec':>5}")

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Live cluster telemetry watch over the scheduler's `metrics` verb.
+
+`top` for a running wormhole job: polls the scheduler's newline-JSON
+control channel, diffs consecutive aggregated snapshots into rates,
+and redraws a terminal view of counter rates, key latency quantiles,
+gauges, and SLO burn — no run restart, no report wait, stdlib only.
+
+    python tools/obs_top.py 127.0.0.1:9000              # live, 2s refresh
+    python tools/obs_top.py 127.0.0.1:9000 --once       # one frame, exit
+    python tools/obs_top.py 127.0.0.1:9000 --prom       # exposition dump
+
+Rates come from the scheduler's snapshot ring (WH_OBS_SCRAPE_SEC) when
+it is populated — so the first frame already has history — and fall
+back to diffing this tool's own consecutive polls otherwise. `--prom`
+prints the same Prometheus text body the WH_OBS_SCRAPE_PORT endpoint
+serves, rendered server-side by the scheduler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from wormhole_tpu.obs.metrics import hist_quantile
+from wormhole_tpu.runtime.tracker import SchedulerClient
+
+_TOP_COUNTERS = 12  # busiest counters shown per frame
+_KEY_HISTS = (
+    "serve.latency_s", "ps.client.rpc_s", "bsp.allreduce_s",
+    "serve.stage.fanout_s", "serve.stage.score_s", "sched.barrier_wait_s",
+)
+
+
+def _rates(prev: tuple | None, cur: tuple) -> dict[str, float]:
+    """Counter deltas/sec between two (ts, snapshot) samples."""
+    if prev is None:
+        return {}
+    (t0, s0), (t1, s1) = prev, cur
+    dt = max(t1 - t0, 1e-6)
+    c0 = s0.get("counters") or {}
+    out = {}
+    for name, v in (s1.get("counters") or {}).items():
+        d = int(v) - int(c0.get(name, 0))
+        if d:
+            out[name] = d / dt
+    return out
+
+
+def render(got: dict, prev: tuple | None,
+           now: float) -> tuple[list[str], tuple]:
+    """One frame of the watch view -> (lines, sample for next diff)."""
+    agg = got.get("aggregate") or {}
+    cur = (now, agg)
+    history = got.get("history") or []
+    if len(history) >= 2:
+        # the scheduler's own sampler has better-aligned timestamps
+        # than our poll loop; diff its last two ring entries
+        prev = (history[-2]["ts"], history[-2]["aggregate"])
+        cur = (history[-1]["ts"], history[-1]["aggregate"])
+    rates = _rates(prev, cur)
+    lines = [f"obs_top · {len(got.get('nodes') or [])} nodes "
+             f"({', '.join(got.get('nodes') or []) or 'local only'}) · "
+             f"{time.strftime('%H:%M:%S', time.localtime(now))}"]
+    if rates:
+        lines.append("")
+        lines.append("counter rates (/s):")
+        top = sorted(rates.items(), key=lambda kv: -kv[1])[:_TOP_COUNTERS]
+        for name, r in top:
+            lines.append(f"  {name:<32} {r:12.1f}")
+    hists = agg.get("hists") or {}
+    hist_lines = []
+    for name in _KEY_HISTS:
+        h = hists.get(name)
+        if not h or not h.get("count"):
+            continue
+        p50 = hist_quantile(h, 0.5)
+        p99 = hist_quantile(h, 0.99)
+        hist_lines.append(
+            f"  {name:<32} p50={p50 * 1e3:9.3f}ms "
+            f"p99={p99 * 1e3:9.3f}ms n={h['count']}")
+    if hist_lines:
+        lines.append("")
+        lines.append("latency:")
+        lines.extend(hist_lines)
+    gauges = agg.get("gauges") or {}
+    gauge_lines = [f"  {name:<32} {float(v):12.3f}"
+                   for name, v in sorted(gauges.items())]
+    if gauge_lines:
+        lines.append("")
+        lines.append("gauges:")
+        lines.extend(gauge_lines)
+    slos = got.get("slos") or []
+    if slos:
+        lines.append("")
+        lines.append("slo burn (>1 = violated):")
+        for v in slos:
+            mark = "ok" if v.get("ok") else "VIOLATED"
+            lines.append(f"  {v['name']:<14} {v['objective']:<28} "
+                         f"burn={v['burn']:g} [{mark}]")
+    return lines, (now, agg)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_top",
+        description="live telemetry watch over a scheduler's metrics verb")
+    ap.add_argument("scheduler_uri", help="host:port of the scheduler")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    ap.add_argument("--prom", action="store_true",
+                    help="dump the Prometheus text exposition and exit")
+    args = ap.parse_args(argv)
+    client = SchedulerClient(args.scheduler_uri, "obs-top")
+    if args.prom:
+        got = client.call(op="metrics", format="prom")
+        sys.stdout.write(got.get("prom") or "")
+        return 0
+    prev = None
+    while True:
+        try:
+            got = client.call(op="metrics", history=1, slo=1)
+        except (OSError, ConnectionError) as e:
+            print(f"[obs_top] scheduler unreachable: {e}", file=sys.stderr)
+            return 1
+        lines, prev = render(got, prev, time.time())
+        if not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print("\n".join(lines), flush=True)
+        if args.once:
+            return 0
+        time.sleep(max(args.interval, 0.1))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,8 @@ import numpy as np
 from wormhole_tpu.data.rowblock import RowBlock
 from wormhole_tpu.models.linear import LinearConfig
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import report as _report
+from wormhole_tpu.obs import slo as _slo
 from wormhole_tpu.serving import LinearScorer, ModelServer, Router
 from wormhole_tpu.utils.manifest import write_snapshot_set
 
@@ -209,6 +211,10 @@ def run(num_shards: int = 2, num_buckets: int = 1 << 20,
     stall_before = before["hists"].get("serve.swap_stall_s") or {}
     stall_ms = ((stall_h.get("sum", 0.0) - stall_before.get("sum", 0.0))
                 * 1e3)
+    # stage decomposition off the after-snapshot reservoirs (the single
+    # warmup request is ~1/reservoir of the samples — noise)
+    stage_table = _report.serve_stage_table(after)
+    slos = _slo.evaluate(after, publish=False)
     row = {
         "shards": num_shards,
         "buckets": num_buckets,
@@ -228,6 +234,24 @@ def run(num_shards: int = 2, num_buckets: int = 1 << 20,
         "epoch_retries": delta("serve.router.epoch_retries"),
         "respawns": state["respawns"],
     }
+    for stage, st in (stage_table.get("stages") or {}).items():
+        row[f"{stage}_ms"] = st["p50_ms"]
+    if stage_table:
+        row["stage_explained_frac"] = stage_table.get("explained_frac")
+    row["slo_ok"] = all(v["ok"] for v in slos) if slos else None
+    if verbose and stage_table:
+        print("[serve-lab] stage attribution (p50/p99/mean ms):",
+              flush=True)
+        for stage, st in stage_table["stages"].items():
+            print(f"  {stage:<7} p50={st['p50_ms']:8.3f} "
+                  f"p99={st['p99_ms']:8.3f} mean={st['mean_ms']:8.3f} "
+                  f"n={st['count']}", flush=True)
+        if stage_table.get("explained_frac") is not None:
+            print(f"  request p50 {stage_table['latency_p50_ms']:.3f} ms, "
+                  f"{stage_table['explained_frac'] * 100:.0f}% explained "
+                  "by pack+fanout+sum+score", flush=True)
+    if verbose and slos:
+        print("\n".join(_slo.format_lines(slos)), flush=True)
     router.close()
     with state_lock:
         servers = list(state["servers"])
@@ -275,7 +299,14 @@ def main(argv=None) -> int:
               f"({row['swap_stall_ms']:.2f} ms stall), "
               f"{row['respawns']} respawns", flush=True)
     print("[serve-lab] " + json.dumps(row, sort_keys=True), flush=True)
-    return 0 if row["errors"] == 0 else 1
+    if row["errors"]:
+        return 1
+    # error-kind SLO violations fail the lab; latency burns are only
+    # reported (this box's speed is not an objective)
+    slo_failed = any(v["kind"] == "errors" and not v["ok"]
+                     for v in _slo.evaluate(_obs.REGISTRY.snapshot(),
+                                            publish=False))
+    return 1 if slo_failed else 0
 
 
 if __name__ == "__main__":

@@ -16,6 +16,15 @@ Open the output in https://ui.perfetto.dev or chrome://tracing. Each
 process incarnation becomes a Chrome "process" named `<node>/<pid>`
 (a respawned server shows up as a second lane next to its dead
 predecessor), threads keep the small integer tids the tracer assigned.
+
+Request stitching: spans of a sampled request carry `trace`/`sid`/
+`psid` (WH_TRACE_SAMPLE, docs/profiling.md). When a child span's
+parent lives in a DIFFERENT process — the shard span a router fan-out
+produced, the PS shard's handler under a sync round — this tool emits
+a Perfetto flow pair (`ph:"s"` at the parent, `ph:"f"` at the child)
+so the UI draws an arrow across the process lanes: one request, one
+track. `--request <trace_id>` instead prints that request's stage
+timeline as indented text (no browser needed).
 """
 
 from __future__ import annotations
@@ -48,10 +57,10 @@ def load_trace_file(path: str) -> tuple[dict | None, list[dict]]:
     return anchor, records
 
 
-def merge_traces(paths: list[str]) -> dict:
-    """Merge trace JSONL files into a Chrome trace dict
-    (`{"traceEvents": [...], "displayTimeUnit": "ms"}`). Files without
-    a clock anchor are skipped (nothing to align them with)."""
+def _load_aligned(paths: list[str]) -> list[tuple[dict, list[dict], list[float]]]:
+    """Load every anchored file and materialize each record's wall
+    time: anchor.wall + (ts - anchor.mono). Sorted by (node, pid) so
+    process lanes are stable."""
     loaded = []
     for p in sorted(paths):
         anchor, records = load_trace_file(p)
@@ -59,24 +68,35 @@ def merge_traces(paths: list[str]) -> dict:
             print(f"[trace_viewer] skipping {p}: no clock anchor",
                   file=sys.stderr)
             continue
-        loaded.append((anchor, records))
+        walls = [anchor["wall"] + (r["ts"] - anchor["mono"])
+                 for r in records]
+        loaded.append((anchor, records, walls))
+    loaded.sort(key=lambda arw: (arw[0].get("node", ""),
+                                 arw[0].get("pid", 0)))
+    return loaded
+
+
+def merge_traces(paths: list[str]) -> dict:
+    """Merge trace JSONL files into a Chrome trace dict
+    (`{"traceEvents": [...], "displayTimeUnit": "ms"}`). Files without
+    a clock anchor are skipped (nothing to align them with)."""
+    loaded = _load_aligned(paths)
     if not loaded:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
 
-    # wall time of a record: anchor.wall + (ts - anchor.mono). Each
-    # record's wall time is materialized BEFORE taking the min so the
-    # earliest event subtracts its own float exactly to 0 — folding the
-    # anchor into a per-file offset instead leaves ~ulp(wall) ≈ 0.5 us
-    # of rounding noise, enough to push early events' ts negative
-    walls = {id(recs): [a["wall"] + (r["ts"] - a["mono"]) for r in recs]
-             for a, recs in loaded}
-    t0 = min((w for ws in walls.values() for w in ws),
+    # each record's wall time is materialized BEFORE taking the min so
+    # the earliest event subtracts its own float exactly to 0 — folding
+    # the anchor into a per-file offset instead leaves ~ulp(wall) ≈
+    # 0.5 us of rounding noise, enough to push early events negative
+    t0 = min((w for _, _, ws in loaded for w in ws),
              default=loaded[0][0]["wall"])
     events = []
     run_ids = set()
-    for pid_num, (anchor, records) in enumerate(
-            sorted(loaded, key=lambda ar: (ar[0].get("node", ""),
-                                           ar[0].get("pid", 0)))):
+    # sid -> (pid_num, tid, start us): flow-arrow sources for children
+    # whose parent span lives in another process
+    sid_at: dict[str, tuple[int, int, float]] = {}
+    cross: list[tuple[str, dict]] = []  # (psid, child event)
+    for pid_num, (anchor, records, walls) in enumerate(loaded):
         run_ids.add(anchor.get("run"))
         name = f"{anchor.get('node', '?')}/{anchor.get('pid', '?')}"
         events.append({"ph": "M", "name": "process_name", "pid": pid_num,
@@ -84,7 +104,7 @@ def merge_traces(paths: list[str]) -> dict:
         events.append({"ph": "M", "name": "process_sort_index",
                        "pid": pid_num, "tid": 0,
                        "args": {"sort_index": pid_num}})
-        for r, rw in zip(records, walls[id(records)]):
+        for r, rw in zip(records, walls):
             ev = {
                 "ph": r.get("ph", "X"),
                 "name": r.get("name", "?"),
@@ -100,12 +120,79 @@ def merge_traces(paths: list[str]) -> dict:
             if r.get("args"):
                 ev["args"] = r["args"]
             events.append(ev)
+            if r.get("sid"):
+                sid_at[r["sid"]] = (pid_num, ev["tid"], ev["ts"])
+            if r.get("psid"):
+                cross.append((r["psid"], ev))
+    # Perfetto flow arrows for parent->child links that cross a process
+    # boundary (in-process nesting is already visible as slice stacking)
+    flow_id = 0
+    for psid, child in cross:
+        parent = sid_at.get(psid)
+        if parent is None or parent[0] == child["pid"]:
+            continue
+        flow_id += 1
+        p_pid, p_tid, p_ts = parent
+        events.append({"ph": "s", "id": flow_id, "cat": "request",
+                       "name": "request", "pid": p_pid, "tid": p_tid,
+                       "ts": p_ts})
+        events.append({"ph": "f", "bp": "e", "id": flow_id,
+                       "cat": "request", "name": "request",
+                       "pid": child["pid"], "tid": child["tid"],
+                       "ts": child["ts"]})
     events.sort(key=lambda e: (e.get("ts", 0), e["pid"], e["tid"]))
     out = {"traceEvents": events, "displayTimeUnit": "ms"}
     run_ids.discard(None)
     if run_ids:
         out["metadata"] = {"run_ids": sorted(run_ids)}
     return out
+
+
+def request_timeline(paths: list[str], trace_id: str) -> list[str]:
+    """Text stage timeline of ONE sampled request: every span carrying
+    the trace id, across every node file, ordered by wall time and
+    indented by span depth (psid chain)."""
+    loaded = _load_aligned(paths)
+    spans = []  # (wall, node, rec)
+    for anchor, records, walls in loaded:
+        node = f"{anchor.get('node', '?')}/{anchor.get('pid', '?')}"
+        for r, rw in zip(records, walls):
+            if r.get("trace") == trace_id:
+                spans.append((rw, node, r))
+    if not spans:
+        return [f"[trace_viewer] no spans carry trace id {trace_id!r}"]
+    spans.sort(key=lambda s: s[0])
+    t0 = spans[0][0]
+    depth_of: dict[str, int] = {}
+
+    def depth(rec: dict) -> int:
+        sid = rec.get("sid")
+        if sid in depth_of:
+            return depth_of[sid]
+        d = 0
+        psid = rec.get("psid")
+        seen = set()
+        while psid and psid not in seen:
+            seen.add(psid)
+            d += 1
+            parent = next((r for _, _, r in spans
+                           if r.get("sid") == psid), None)
+            psid = parent.get("psid") if parent else None
+        if sid:
+            depth_of[sid] = d
+        return d
+
+    node_w = max(len(n) for _, n, _ in spans)
+    lines = [f"request {trace_id}: {len(spans)} spans across "
+             f"{len({n for _, n, _ in spans})} processes"]
+    for rw, node, r in spans:
+        off = (rw - t0) * 1e3
+        dur = r.get("dur")
+        dur_s = f"{dur * 1e3:9.3f} ms" if dur is not None else " " * 12
+        indent = "  " * depth(r)
+        lines.append(f"  {off:9.3f} ms  {dur_s}  {node:<{node_w}}  "
+                     f"{indent}{r.get('name', '?')}")
+    return lines
 
 
 def main(argv=None) -> int:
@@ -117,18 +204,27 @@ def main(argv=None) -> int:
                          "(the WH_OBS_DIR of the run)")
     ap.add_argument("-o", "--out", default=None,
                     help="output path (default: <obs_dir>/trace.json)")
+    ap.add_argument("--request", default=None, metavar="TRACE_ID",
+                    help="print one sampled request's stage timeline "
+                         "as text instead of writing Chrome JSON")
     args = ap.parse_args(argv)
     paths = glob.glob(os.path.join(args.obs_dir, "trace-*.jsonl"))
     if not paths:
         print(f"[trace_viewer] no trace-*.jsonl under {args.obs_dir}",
               file=sys.stderr)
         return 1
+    if args.request:
+        lines = request_timeline(paths, args.request)
+        print("\n".join(lines))
+        return 0 if len(lines) > 1 else 1
     merged = merge_traces(paths)
     out = args.out or os.path.join(args.obs_dir, "trace.json")
     with open(out, "w") as fh:
         json.dump(merged, fh)
     n = sum(1 for e in merged["traceEvents"] if e["ph"] != "M")
-    print(f"[trace_viewer] {len(paths)} files, {n} events -> {out}")
+    flows = sum(1 for e in merged["traceEvents"] if e["ph"] == "s")
+    print(f"[trace_viewer] {len(paths)} files, {n} events, "
+          f"{flows} cross-process links -> {out}")
     return 0
 
 

@@ -5,9 +5,10 @@ literals COUNTERS/GAUGES/HISTOGRAMS/SPANS/EVENTS map names — with ``*``
 wildcards for f-string interpolations — to doc strings.
 
 Emit sites are ``REGISTRY.counter/gauge/histogram("...")`` handles,
-``trace.span("...")`` / ``trace.event("...")`` and ``emit_span("...")``
-calls. Constant names check exactly; f-strings check as patterns; variable
-names are unresolvable and skipped.
+``trace.span("...")`` / ``trace.request_span("...")`` /
+``trace.event("...")`` and ``emit_span("...")`` calls. Constant names
+check exactly; f-strings check as patterns; variable names are
+unresolvable and skipped.
 
 Findings: emit of an unregistered name, a name violating the dotted
 lowercase convention, and a registered name nothing emits.
@@ -71,9 +72,9 @@ def _emit_site(call: ast.Call) -> Optional[tuple[str, ast.AST]]:
         return None
     if f.attr in _METRIC_METHODS and terminal_name(f.value) == "REGISTRY":
         return _METRIC_METHODS[f.attr], call.args[0]
-    if f.attr in ("span", "event") and \
+    if f.attr in ("span", "request_span", "event") and \
             terminal_name(f.value) in _TRACE_ROOTS:
-        return f.attr, call.args[0]
+        return ("event" if f.attr == "event" else "span"), call.args[0]
     if f.attr == "emit_span":
         return "span", call.args[0]
     return None

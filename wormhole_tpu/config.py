@@ -235,6 +235,29 @@ declare_knob("WH_OBS_DIR", str, "",
 declare_knob("WH_RUN_ID", str, None,
              "Run identifier stamped into traces/reports; generated by the "
              "launcher when unset.", group="obs")
+declare_knob("WH_TRACE_SAMPLE", int, 0,
+             "Cross-node request-trace sampling: every Nth request / PS sync "
+             "round / BSP round carries a trace context over the wire "
+             "(1 = every request, 0 = off). Needs WH_OBS_DIR.", group="obs")
+declare_knob("WH_OBS_SCRAPE_SEC", float, 0.0,
+             "Scheduler telemetry sampler period in seconds: each tick "
+             "appends the aggregated cluster snapshot to an in-memory ring "
+             "(the `metrics` verb's history=1 view). 0 = off.", group="obs")
+declare_knob("WH_OBS_RING", int, 120,
+             "Capacity of the scheduler's metrics-snapshot ring buffer.",
+             group="obs")
+declare_knob("WH_OBS_SCRAPE_PORT", int, 0,
+             "Prometheus text-exposition HTTP port on the scheduler "
+             "(GET /metrics). 0 = off.", group="obs")
+declare_knob("WH_SLO_SERVE_P99_MS", float, 500.0,
+             "Serving latency SLO: p99 of serve.latency_s must stay under "
+             "this many milliseconds.", group="obs")
+declare_knob("WH_SLO_SERVE_ERR_BUDGET", float, 0.001,
+             "Serving error SLO: failed fraction of router requests allowed "
+             "before the error budget is burned.", group="obs")
+declare_knob("WH_SLO_PS_RPC_P99_MS", float, 250.0,
+             "PS RPC latency SLO: p99 of ps.client.rpc_s must stay under "
+             "this many milliseconds.", group="obs")
 
 # data pipeline
 declare_knob("WH_PACK_CACHE", bool, False,

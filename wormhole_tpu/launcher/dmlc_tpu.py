@@ -96,7 +96,10 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            snapshot_dir: str | None = None,
            pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
                                         "PYTHONPATH", "WH_PS_PLANE",
-                                        "WH_NET_COMPRESS")) -> int:
+                                        "WH_NET_COMPRESS",
+                                        "WH_TRACE_SAMPLE",
+                                        "WH_OBS_SCRAPE_SEC",
+                                        "WH_OBS_SCRAPE_PORT")) -> int:
     """Spawn the scheduler + N workers of `cmd`; stream their output with
     role prefixes; return the first nonzero exit code (0 if all clean).
     On scheduler exit, surviving workers are terminated (the reference

@@ -236,6 +236,32 @@ def merge_snapshots(snaps, reservoir: int = DEFAULT_RESERVOIR) -> dict:
     return {"counters": counters, "gauges": gauges, "hists": hists}
 
 
+class SnapshotRing:
+    """Bounded ring of timestamped metrics snapshots — the scheduler's
+    metrics-over-time buffer. ``add`` evicts the oldest entry past
+    capacity; ``items`` hands back oldest-first copies, so a scraper
+    can diff consecutive entries into rates without holding the lock."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(int(capacity), 1)
+        self._lock = threading.Lock()
+        self._entries: list[tuple[float, dict]] = []
+
+    def add(self, ts: float, snap: dict) -> None:
+        with self._lock:
+            self._entries.append((float(ts), snap))
+            if len(self._entries) > self.capacity:
+                del self._entries[: len(self._entries) - self.capacity]
+
+    def items(self) -> list[tuple[float, dict]]:
+        with self._lock:
+            return list(self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 def hist_quantile(h: dict | None, q: float) -> float | None:
     """Quantile of a snapshot-form histogram dict (or None)."""
     if not h:

@@ -70,6 +70,7 @@ COUNTERS: dict[str, str] = {
     "pack_cache.disk_hits": "disk-tier pack cache hits",
     "pack_cache.evictions": "LRU evictions from the memory tier",
     "pack_cache.corrupt": "disk entries dropped after checksum failure",
+    "obs.scrape.requests": "Prometheus /metrics scrapes served",
 }
 
 GAUGES: dict[str, str] = {
@@ -81,12 +82,20 @@ GAUGES: dict[str, str] = {
     "loader.stall_s": "main-thread queue-wait total for the pass",
     "loader.pool_size": "current loader thread-pool size",
     "pack_cache.bytes": "bytes held by the pack cache memory tier",
+    "obs.ring.depth": "snapshots held by the scheduler's telemetry ring",
+    "slo.*_burn": "error-budget burn rate per declared SLO (>1 = violated)",
 }
 
 HISTOGRAMS: dict[str, str] = {
     "ps.server.snapshot_s": "shard snapshot write duration",
     "serve.op.*_s": "per-op serving-shard handler duration",
     "serve.latency_s": "router-side end-to-end predict batch latency",
+    "serve.stage.pack_s": "router pack stage (RowBlock -> device batch + keys)",
+    "serve.stage.fanout_s": "fan-out wall: RPCs issued to all replies in",
+    "serve.stage.wire_s": "fan-out wall minus slowest shard's own time",
+    "serve.stage.queue_s": "slowest shard's recv-to-dispatch queue wait",
+    "serve.stage.score_s": "jitted margin compute over compact tables",
+    "serve.stage.sum_s": "shard-piece reassembly into compact tables",
     "serve.swap_stall_s": "request-visible pause while flipping snapshots",
     "ps.server.op.*_s": "per-op PS server handler duration",
     "ps.client.rpc_s": "single client RPC round-trip",
@@ -114,6 +123,17 @@ SPANS: dict[str, str] = {
     "solver.part": "one data part processed by a worker",
     "solver.*_pass": "one train/eval pass over the data",
     "solver.*_step": "one train/eval minibatch step",
+    "serve.request": "root span of a sampled router predict request",
+    "serve.rpc.fetch": "router-side shard fetch RPC within a fan-out",
+    "serve.stage.pack": "pack stage of a sampled predict request",
+    "serve.stage.fanout": "fan-out stage of a sampled predict request",
+    "serve.stage.score": "score stage of a sampled predict request",
+    "serve.stage.sum": "piece-reassembly stage of a sampled request",
+    "serve.shard.*": "serving-shard handler work, named by op",
+    "ps.shard.*": "PS-shard handler work under a sampled round, by op",
+    "ps.sync.round": "root span of a sampled PS sync round",
+    "bsp.round": "root span of a sampled BSP collective round",
+    "bsp.peer.*": "BSP peer handler work under a sampled round, by op",
 }
 
 EVENTS: dict[str, str] = {

@@ -24,9 +24,46 @@ import os
 import time
 
 from wormhole_tpu.obs import metrics
+from wormhole_tpu.obs import slo as _slo
 
 REPORT_PREFIX = "[run-report] "
 REPORT_NAME = "run_report.json"
+
+#: serving pipeline stages, in request order; wire/queue decompose
+#: fanout (they overlap it, so the explained sum doesn't count them)
+SERVE_STAGES = ("pack", "fanout", "wire", "queue", "score", "sum")
+_PIPELINE_STAGES = ("pack", "fanout", "sum", "score")
+
+
+def serve_stage_table(aggregate: dict) -> dict:
+    """Per-stage serving-latency attribution from the serve.stage.*
+    histograms: {stages: {name: {p50_ms, p99_ms, mean_ms, count}},
+    latency_p50_ms, explained_p50_ms, explained_frac}. Empty when the
+    run never served. ``explained_frac`` is the acceptance metric: the
+    pipeline stages' p50 sum over the end-to-end request p50."""
+    hists = aggregate.get("hists") or {}
+    stages = {}
+    for stage in SERVE_STAGES:
+        h = hists.get(f"serve.stage.{stage}_s")
+        if not h or not h.get("count"):
+            continue
+        stages[stage] = {
+            "p50_ms": _round3(_ms(metrics.hist_quantile(h, 0.50))),
+            "p99_ms": _round3(_ms(metrics.hist_quantile(h, 0.99))),
+            "mean_ms": _round3(_ms(h["sum"] / h["count"])),
+            "count": h["count"],
+        }
+    if not stages:
+        return {}
+    out = {"stages": stages}
+    p50 = _ms(metrics.hist_quantile(hists.get("serve.latency_s"), 0.50))
+    explained = sum(stages[s]["p50_ms"] or 0.0
+                    for s in _PIPELINE_STAGES if s in stages)
+    out["latency_p50_ms"] = _round3(p50)
+    out["explained_p50_ms"] = _round3(explained)
+    out["explained_frac"] = (_round3(explained / p50)
+                             if p50 else None)
+    return out
 
 
 def enabled() -> bool:
@@ -93,6 +130,12 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "hists": {k: metrics.hist_stats(h) for k, h in sorted(hists.items())
                   if h and h.get("count")},
     }
+    stages = serve_stage_table(aggregate)
+    if stages:
+        report["serve_stages"] = stages
+    slos = _slo.evaluate(aggregate)
+    if slos:
+        report["slos"] = slos
     if ps_stats:
         report["ps_servers"] = {str(k): v for k, v in sorted(ps_stats.items())}
     if extra:
@@ -176,8 +219,25 @@ def format_lines(report: dict) -> list[str]:
         lines.append(
             f"  hot plane: steps={s['hot_plane_steps']} "
             f"cold_flushes={s['hot_plane_flushes']}")
+    stages = report.get("serve_stages")
+    if stages:
+        lines.append(
+            "  serve stages (p50 ms): "
+            + " ".join(f"{k}={v['p50_ms']:.2f}"
+                       for k, v in stages["stages"].items()))
+        if stages.get("explained_frac") is not None:
+            lines.append(
+                f"  serve latency p50={stages['latency_p50_ms']:.2f}ms, "
+                f"{stages['explained_frac'] * 100:.0f}% explained by "
+                "pack+fanout+sum+score")
+    if report.get("slos"):
+        lines.extend(_slo.format_lines(report["slos"]))
     return lines
 
 
 def _ms(v):
     return None if v is None else v * 1000.0
+
+
+def _round3(v):
+    return None if v is None else round(v, 3)

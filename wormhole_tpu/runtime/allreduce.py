@@ -57,6 +57,7 @@ import numpy as np
 
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime.net import (connect_with_retry, recv_frame,
                                       send_frame)
@@ -99,7 +100,15 @@ class _BspHandler(socketserver.StreamRequestHandler):
             if got is None:
                 return
             header, arrays, _ = got
-            send_frame(self.wfile, *worker._handle(header, arrays))
+            # a sampled BSP round's trace context rides bsp_step/fetch
+            # frames; adopting it stitches this peer's handler work
+            # under the initiating rank's round span
+            with _trace.bind_wire(header):
+                with _trace.request_span(
+                        f"bsp.peer.{header.get('op')}", cat="bsp",
+                        rank=worker.rank):
+                    resp = worker._handle(header, arrays)
+            send_frame(self.wfile, *resp)
 
 
 class _BspServer(socketserver.ThreadingTCPServer):
@@ -405,8 +414,11 @@ class BspWorker:
         # and solver scalars (raw losses) must round-trip shape ()
         x = np.asarray(x, np.float32)
         key = (self.version, self.seq)
-        out = self._collective(key, np.ascontiguousarray(x.ravel()),
-                               _OPS[op]).reshape(x.shape)
+        with _trace.bind(_trace.start_request()), \
+                _trace.request_span("bsp.round", cat="bsp",
+                                    ver=key[0], seq=key[1]):
+            out = self._collective(key, np.ascontiguousarray(x.ravel()),
+                                   _OPS[op]).reshape(x.shape)
         with self._results_lock:
             self._results[key] = out
         self.seq += 1  # AFTER the cache write: next>key implies cached

@@ -47,6 +47,7 @@ from typing import Optional
 import numpy as np
 
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 
 _COMPRESS_MIN = 512  # don't bother compressing tiny buffers
@@ -237,6 +238,13 @@ def send_frame(sock_file, header: dict,
         metas.append(m)
         bufs.append(b)
     header = dict(header, arrays=metas)
+    if _trace.ACTIVE is not None:
+        # a sampled request's trace context rides the header (the
+        # key_digest piggyback pattern) so the receiver's spans stitch
+        # to the sender's in tools/trace_viewer.py
+        tc = _trace.wire_ctx()
+        if tc is not None:
+            header["tctx"] = tc
     h = json.dumps(header).encode()
     _ENCODE_S.observe(time.perf_counter() - t0)
     comp = sum(m["nbytes"] for m in metas if "comp" in m)

@@ -178,7 +178,11 @@ class _PSHandler(socketserver.StreamRequestHandler):
                                             epoch=node.epoch))
                 continue
             try:
-                resp_header, resp_arrays = node._dispatch(header, arrays)
+                # adopt the trace context a sampled sync round carried
+                # so this shard's spans stitch under the client's round
+                with _trace.bind_wire(header):
+                    resp_header, resp_arrays = node._dispatch(header,
+                                                              arrays)
             finally:
                 node._gate.leave()
             if (header.get("op") == "hello" and header.get("net_compress")
@@ -354,7 +358,9 @@ class ServerNode:
         op = header.get("op")
         t0 = time.perf_counter()
         try:
-            return self._dispatch_op(op, header, arrays)
+            with _trace.request_span(f"ps.shard.{op}", cat="ps",
+                                     rank=self.rank):
+                return self._dispatch_op(op, header, arrays)
         finally:
             # per-op service latency (what the server spent, not what the
             # client waited — that's ps.client.rpc_s)
@@ -1384,6 +1390,16 @@ class PSClient:
             self._pool = concurrent.futures.ThreadPoolExecutor(  # wormlint: thread-owned
                 max_workers=min(self.world, 8),
                 thread_name_prefix="ps-rpc")
+        ctx = _trace.current_ctx()
+        if ctx is not None:
+            # pool threads don't inherit thread-locals: rebind the
+            # sampled sync round's trace context so each per-rank RPC
+            # frame carries it to its server shard
+            inner = fn
+
+            def fn(r, _inner=inner, _ctx=ctx):
+                with _trace.bind(_ctx):
+                    return _inner(r)
         futs = [self._pool.submit(fn, r) for r in range(self.world)]
         return [f.result() for f in futs]
 
@@ -1868,15 +1884,20 @@ class SyncedStore:
                 return
             t0 = time.perf_counter()
             try:
-                with _trace.span("ps.sync.push", cat="ps"):
-                    self.client.push_sparse(
-                        job["groups"], job["deltas"],
-                        fixed_bytes=self.fixed_bytes,
-                        compress=self.compress)
-                t1 = time.perf_counter()
-                with _trace.span("ps.sync.pull", cat="ps"):
-                    job["pull"] = self.client.pull_sparse(
-                        self._clocks, compress=self.compress)
+                # every WH_TRACE_SAMPLE-th round gets a trace context
+                # that rides the push/pull frames, so the PS shards'
+                # handler spans stitch under this round cross-node
+                with _trace.bind(_trace.start_request()), \
+                        _trace.request_span("ps.sync.round", cat="ps"):
+                    with _trace.span("ps.sync.push", cat="ps"):
+                        self.client.push_sparse(
+                            job["groups"], job["deltas"],
+                            fixed_bytes=self.fixed_bytes,
+                            compress=self.compress)
+                    t1 = time.perf_counter()
+                    with _trace.span("ps.sync.pull", cat="ps"):
+                        job["pull"] = self.client.pull_sparse(
+                            self._clocks, compress=self.compress)
                 t2 = time.perf_counter()
                 _SYNC_PUSH_S.observe(t1 - t0)
                 _SYNC_PULL_S.observe(t2 - t1)
@@ -2006,17 +2027,19 @@ class SyncedStore:
         """The original synchronous round-trip (also the async mode's
         barrier step): push deltas, then pull+apply the merged rows."""
         t0 = time.perf_counter()
-        with _trace.span("ps.sync.push", cat="ps"):
-            got = self._touched_groups()
-            if got is None:
-                got = self._scan_groups()
-            groups, deltas = got
-            self.client.push_sparse(groups, deltas,
-                                    fixed_bytes=self.fixed_bytes,
-                                    compress=self.compress)
-        t1 = time.perf_counter()
-        with _trace.span("ps.sync.pull", cat="ps"):
-            self._apply_pull()
+        with _trace.bind(_trace.start_request()), \
+                _trace.request_span("ps.sync.round", cat="ps"):
+            with _trace.span("ps.sync.push", cat="ps"):
+                got = self._touched_groups()
+                if got is None:
+                    got = self._scan_groups()
+                groups, deltas = got
+                self.client.push_sparse(groups, deltas,
+                                        fixed_bytes=self.fixed_bytes,
+                                        compress=self.compress)
+            t1 = time.perf_counter()
+            with _trace.span("ps.sync.pull", cat="ps"):
+                self._apply_pull()
         t2 = time.perf_counter()
         _SYNC_PUSH_S.observe(t1 - t0)
         _SYNC_PULL_S.observe(t2 - t1)

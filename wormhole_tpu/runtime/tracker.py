@@ -33,7 +33,10 @@ import time
 from enum import Enum
 from typing import Optional
 
+from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import prom as _prom
+from wormhole_tpu.obs import slo as _slo
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime.net import connect_with_retry
@@ -45,6 +48,8 @@ _SRV_RECOVERIES = _obs.REGISTRY.counter("sched.server_recoveries")
 _SERVE_RECOVERIES = _obs.REGISTRY.counter("sched.serve_recoveries")
 _BSP_RECOVERIES = _obs.REGISTRY.counter("bsp.recoveries")
 _BARRIER_WAIT_S = _obs.REGISTRY.histogram("sched.barrier_wait_s")
+_SCRAPES = _obs.REGISTRY.counter("obs.scrape.requests")
+_RING_DEPTH = _obs.REGISTRY.gauge("obs.ring.depth")
 
 
 class Role(str, Enum):
@@ -138,6 +143,14 @@ class Scheduler:
         self._node_metrics: dict[str, dict] = {}
         self.num_server_recoveries = 0           # servers that re-registered
         self._done = False
+        self._stop_evt = threading.Event()
+        # metrics-over-time: a periodic sampler (WH_OBS_SCRAPE_SEC)
+        # appends the aggregated cluster snapshot to this ring; the
+        # `metrics` verb serves it as `history`
+        self._snap_ring = _obs.SnapshotRing(int(knob_value("WH_OBS_RING")))
+        self._scrape_sec = float(knob_value("WH_OBS_SCRAPE_SEC"))
+        self._scrape_port = int(knob_value("WH_OBS_SCRAPE_PORT"))
+        self._scrape_srv = None  # Prometheus HTTP endpoint, if enabled
         self._srv = _Server((host, port), _Handler)
         self._srv.scheduler = self  # type: ignore
         self._threads: list[threading.Thread] = []
@@ -157,6 +170,12 @@ class Scheduler:
         w = threading.Thread(target=self._liveness_loop, daemon=True)
         w.start()
         self._threads.append(w)
+        if self._scrape_sec > 0:
+            s = threading.Thread(target=self._scrape_loop, daemon=True)
+            s.start()
+            self._threads.append(s)
+        if self._scrape_port > 0:
+            self._start_scrape_server()
 
     def announce_shutdown(self) -> None:
         """Mark the job finished; workers see it on their next epoch poll
@@ -166,7 +185,12 @@ class Scheduler:
 
     def stop(self) -> None:
         self._done = True
+        self._stop_evt.set()
         self.pool.stop_straggler_killer()
+        if self._scrape_srv is not None:
+            self._scrape_srv.shutdown()
+            self._scrape_srv.server_close()
+            self._scrape_srv = None
         self._srv.shutdown()
         self._srv.server_close()
 
@@ -312,7 +336,21 @@ class Scheduler:
                 # final one rides the worker's `bye`)
                 self._node_metrics[node] = snap
         if op == "metrics":
-            return {"ok": True, **self.aggregate_metrics()}
+            got = self.aggregate_metrics()
+            if req.get("format") == "prom":
+                # Prometheus text exposition over the RPC channel, for
+                # scrapers that bridge the newline-JSON protocol (the
+                # WH_OBS_SCRAPE_PORT endpoint serves the same body)
+                return {"ok": True, "nodes": got["nodes"],
+                        "prom": _prom.render_snapshot(got["aggregate"])}
+            out = {"ok": True, **got}
+            if req.get("history"):
+                out["history"] = [{"ts": ts, "aggregate": snap}
+                                  for ts, snap in self._snap_ring.items()]
+            if req.get("slo"):
+                out["slos"] = _slo.evaluate(got["aggregate"],
+                                            publish=False)
+            return out
         if op == "register":
             return {"ok": True, "epoch": self._epoch}
         if op == "register_server":
@@ -506,6 +544,55 @@ class Scheduler:
             return {"released": False, "gen": gen}
 
     # -- telemetry ----------------------------------------------------------
+    def _scrape_loop(self) -> None:  # wormlint: thread-entry
+        """WH_OBS_SCRAPE_SEC sampler: append the aggregated cluster
+        snapshot to the ring every tick (metrics over time, not just
+        final values) and refresh the slo.*_burn gauges so burn rates
+        ride heartbeats and scrapes like any other metric."""
+        while not self._stop_evt.wait(self._scrape_sec):
+            try:
+                got = self.aggregate_metrics()
+            except Exception:
+                continue  # a malformed node snapshot must not kill it
+            _slo.evaluate(got["aggregate"])
+            self._snap_ring.add(time.time(), got["aggregate"])
+            _RING_DEPTH.set(float(len(self._snap_ring)))
+
+    def _start_scrape_server(self) -> None:
+        """Prometheus text-exposition endpoint (WH_OBS_SCRAPE_PORT):
+        GET /metrics renders the live aggregated snapshot."""
+        import http.server
+
+        sched = self
+
+        class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                _SCRAPES.inc()
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = _prom.render_snapshot(
+                    sched.aggregate_metrics()["aggregate"]).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass  # scrapes are periodic; don't spam stderr
+
+        host = self._srv.server_address[0]
+        self._scrape_srv = http.server.ThreadingHTTPServer(
+            (host, self._scrape_port), _MetricsHandler)
+        t = threading.Thread(target=self._scrape_srv.serve_forever,
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
     def aggregate_metrics(self) -> dict:
         """Cluster-wide metrics view: this process's registry merged
         with the latest snapshot each node piggybacked on a heartbeat.

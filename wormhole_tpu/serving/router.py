@@ -35,6 +35,7 @@ import numpy as np
 
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime.net import (
     busy_backoff, connect_with_retry, recv_frame, send_frame,
 )
@@ -45,6 +46,17 @@ _ROUTER_RETRIES = _obs.REGISTRY.counter("serve.router.retries")
 _EPOCH_RETRIES = _obs.REGISTRY.counter("serve.router.epoch_retries")
 _FAILURES = _obs.REGISTRY.counter("serve.router.failures")
 _LATENCY_S = _obs.REGISTRY.histogram("serve.latency_s")
+
+# stage decomposition of one predict request (docs/serving.md): the
+# sum of pack+fanout+sum+score p50s should explain the latency p50,
+# and fanout further splits into wire vs shard queue/serve time via
+# the queue_s/served_s fields fetch replies carry back
+_STAGE_PACK_S = _obs.REGISTRY.histogram("serve.stage.pack_s")
+_STAGE_FANOUT_S = _obs.REGISTRY.histogram("serve.stage.fanout_s")
+_STAGE_WIRE_S = _obs.REGISTRY.histogram("serve.stage.wire_s")
+_STAGE_QUEUE_S = _obs.REGISTRY.histogram("serve.stage.queue_s")
+_STAGE_SCORE_S = _obs.REGISTRY.histogram("serve.stage.score_s")
+_STAGE_SUM_S = _obs.REGISTRY.histogram("serve.stage.sum_s")
 
 _EPOCH_REPLAYS = 8  # fan-out replays before a mixed-version batch fails
 
@@ -210,8 +222,20 @@ class Router:
             out.append(slice(int(a), int(b)))
         return out
 
-    def _fanout(self, packed) -> tuple[Dict[str, np.ndarray], int]:
-        """One fetch round: returns (rows per table, model version) or
+    def _rpc_traced(self, ctx, r: int, header: dict,
+                    arrays: Dict[str, np.ndarray]) -> tuple[dict, dict]:
+        """Pool-thread RPC entry: rebind the request's trace context
+        (executor threads don't inherit thread-locals) so the frame
+        carries it over the wire and the shard's span links back."""
+        if ctx is None:
+            return self._rpc(r, header, arrays)
+        with _trace.bind(ctx):
+            with _trace.request_span("serve.rpc.fetch", cat="serve",
+                                     shard=r):
+                return self._rpc(r, header, arrays)
+
+    def _fanout(self, packed) -> tuple[list, list, int]:
+        """One fetch round: returns (jobs, replies, model version) or
         raises on a mixed-version set (caller replays)."""
         tables = list(self.scorer.tables)
         splits = {t: self._split(packed.keys[t], self.full_rows[t])
@@ -225,30 +249,46 @@ class Router:
             arrays = {f"k:{t}": packed.keys[t][splits[t][r]]
                       for t in present}
             jobs.append((r, present, arrays))
+        ctx = _trace.current_ctx()
         futs = [self._pool.submit(
-            self._rpc, r, {"op": "fetch", "tables": present}, arrays)
+            self._rpc_traced, ctx, r,
+            {"op": "fetch", "tables": present}, arrays)
             for r, present, arrays in jobs]
         got = [f.result() for f in futs]
         versions = {int(reply["version"]) for reply, _ in got}
         if len(versions) > 1:
             raise _MixedVersions(versions)
-        pieces: Dict[str, list] = {t: [] for t in tables}
+        return jobs, got, versions.pop()
+
+    def _merge(self, jobs: list, got: list) -> Dict[str, np.ndarray]:
+        """Reassemble per-shard row pieces into each table's compact
+        rows (shard order == key order, so concatenation suffices)."""
+        pieces: Dict[str, list] = {t: [] for t in self.scorer.tables}
         for (_, present, _), (_, rarr) in zip(jobs, got):
             for t in present:
                 pieces[t].append(np.asarray(rarr[f"r:{t}"]))
-        rows = {t: (p[0] if len(p) == 1 else np.concatenate(p))
+        return {t: (p[0] if len(p) == 1 else np.concatenate(p))
                 for t, p in pieces.items()}
-        return rows, versions.pop()
 
     def predict_block(self, blk) -> tuple[np.ndarray, int]:
         """Score one RowBlock; returns (scores[:size], model version).
         The scores are guaranteed to come from ONE snapshot version."""
+        ctx = _trace.start_request()
+        with _trace.bind(ctx):
+            with _trace.request_span("serve.request", cat="serve"):
+                return self._predict_block(blk)
+
+    def _predict_block(self, blk) -> tuple[np.ndarray, int]:
         t0 = time.perf_counter()
         packed = self.scorer.pack(blk)
+        _STAGE_PACK_S.observe(time.perf_counter() - t0)
         try:
             for attempt in range(_EPOCH_REPLAYS):
+                tf0 = time.perf_counter()
                 try:
-                    rows, version = self._fanout(packed)
+                    with _trace.request_span("serve.stage.fanout",
+                                             cat="serve"):
+                        jobs, got, version = self._fanout(packed)
                 except _MixedVersions:
                     # a hot swap landed mid-fan-out; replay against the
                     # (now uniform) new version. Shard watchers can be
@@ -260,7 +300,25 @@ class Router:
                     poll = float(knob_value("WH_SERVE_POLL_SEC"))
                     time.sleep(min(0.01 * (2 ** attempt), max(poll, 0.01)))
                     continue
+                fanout = time.perf_counter() - tf0
+                # wire share = fan-out wall minus the slowest shard's
+                # own (queue + serve) time, which replies carry back
+                slowest = max(
+                    (float(r.get("served_s", 0.0))
+                     + float(r.get("queue_s", 0.0)) for r, _ in got),
+                    default=0.0)
+                queued = max((float(r.get("queue_s", 0.0))
+                              for r, _ in got), default=0.0)
+                _STAGE_FANOUT_S.observe(fanout)
+                _STAGE_WIRE_S.observe(max(fanout - slowest, 0.0))
+                _STAGE_QUEUE_S.observe(queued)
+                tm0 = time.perf_counter()
+                with _trace.request_span("serve.stage.sum", cat="serve"):
+                    rows = self._merge(jobs, got)
+                _STAGE_SUM_S.observe(time.perf_counter() - tm0)
+                ts0 = time.perf_counter()
                 scores = self.scorer.score(packed, rows)
+                _STAGE_SCORE_S.observe(time.perf_counter() - ts0)
                 _ROUTER_REQUESTS.inc()
                 _LATENCY_S.observe(time.perf_counter() - t0)
                 return scores, version

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
+from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.ops.spmv import row_squares, spmm, spmv
 
 _MIN_CAP = 256
@@ -103,27 +104,32 @@ class LinearScorer:
 
     def pack(self, blk: RowBlock) -> PackedBatch:
         cfg = self.cfg
-        db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
-                             cfg.num_buckets)
-        uniq, idxc = np.unique(db.idx, return_inverse=True)
-        return PackedBatch(
-            seg=db.seg, val=db.val,
-            size=min(blk.size, cfg.minibatch) - db.dropped_rows,
-            keys={"w": uniq.astype(np.int64)},
-            remap={"w": idxc.astype(np.int32)},
-            dropped_rows=db.dropped_rows)
+        with _trace.request_span("serve.stage.pack", cat="serve",
+                                 rows=blk.size):
+            db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
+                                 cfg.num_buckets)
+            uniq, idxc = np.unique(db.idx, return_inverse=True)
+            return PackedBatch(
+                seg=db.seg, val=db.val,
+                size=min(blk.size, cfg.minibatch) - db.dropped_rows,
+                keys={"w": uniq.astype(np.int64)},
+                remap={"w": idxc.astype(np.int32)},
+                dropped_rows=db.dropped_rows)
 
     def score(self, packed: PackedBatch,
               rows: Dict[str, np.ndarray]) -> np.ndarray:
-        cap = _cap(len(packed.keys["w"]))
-        xw = _linear_margin(
-            jnp.asarray(packed.seg), jnp.asarray(packed.remap["w"]),
-            jnp.asarray(packed.val), jnp.asarray(_padded(rows["w"], cap)),
-            num_rows=self.cfg.minibatch)
-        out = np.asarray(xw)[: packed.size]
-        if getattr(self.cfg, "prob_predict", False):
-            out = 1.0 / (1.0 + np.exp(-out))
-        return out
+        with _trace.request_span("serve.stage.score", cat="serve",
+                                 keys=len(packed.keys["w"])):
+            cap = _cap(len(packed.keys["w"]))
+            xw = _linear_margin(
+                jnp.asarray(packed.seg), jnp.asarray(packed.remap["w"]),
+                jnp.asarray(packed.val),
+                jnp.asarray(_padded(rows["w"], cap)),
+                num_rows=self.cfg.minibatch)
+            out = np.asarray(xw)[: packed.size]
+            if getattr(self.cfg, "prob_predict", False):
+                out = 1.0 / (1.0 + np.exp(-out))
+            return out
 
 
 class DifactoScorer:
@@ -139,35 +145,39 @@ class DifactoScorer:
 
     def pack(self, blk: RowBlock) -> PackedBatch:
         cfg = self.cfg
-        db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
-                             cfg.num_buckets)
-        vidx = (db.idx % np.int32(cfg.vb)).astype(np.int32)
-        uniq_w, idxc = np.unique(db.idx, return_inverse=True)
-        uniq_v, vidxc = np.unique(vidx, return_inverse=True)
-        uniq_w = uniq_w.astype(np.int64)
-        uniq_v = uniq_v.astype(np.int64)
-        return PackedBatch(
-            seg=db.seg, val=db.val,
-            size=min(blk.size, cfg.minibatch) - db.dropped_rows,
-            keys={"w": uniq_w, "cnt": uniq_w, "V": uniq_v},
-            remap={"w": idxc.astype(np.int32),
-                   "V": vidxc.astype(np.int32)},
-            dropped_rows=db.dropped_rows)
+        with _trace.request_span("serve.stage.pack", cat="serve",
+                                 rows=blk.size):
+            db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
+                                 cfg.num_buckets)
+            vidx = (db.idx % np.int32(cfg.vb)).astype(np.int32)
+            uniq_w, idxc = np.unique(db.idx, return_inverse=True)
+            uniq_v, vidxc = np.unique(vidx, return_inverse=True)
+            uniq_w = uniq_w.astype(np.int64)
+            uniq_v = uniq_v.astype(np.int64)
+            return PackedBatch(
+                seg=db.seg, val=db.val,
+                size=min(blk.size, cfg.minibatch) - db.dropped_rows,
+                keys={"w": uniq_w, "cnt": uniq_w, "V": uniq_v},
+                remap={"w": idxc.astype(np.int32),
+                       "V": vidxc.astype(np.int32)},
+                dropped_rows=db.dropped_rows)
 
     def score(self, packed: PackedBatch,
               rows: Dict[str, np.ndarray]) -> np.ndarray:
         cfg = self.cfg
-        cap_w = _cap(len(packed.keys["w"]))
-        cap_v = _cap(len(packed.keys["V"]))
-        margin = _fm_margin(
-            jnp.asarray(packed.seg), jnp.asarray(packed.remap["w"]),
-            jnp.asarray(packed.remap["V"]), jnp.asarray(packed.val),
-            jnp.asarray(_padded(rows["w"], cap_w)),
-            jnp.asarray(_padded(rows["cnt"], cap_w)),
-            jnp.asarray(_padded(rows["V"], cap_v)),
-            num_rows=cfg.minibatch, threshold=int(cfg.threshold),
-            l1_shrk=bool(cfg.l1_shrk))
-        out = np.asarray(margin)[: packed.size]
-        if getattr(cfg, "prob_predict", False):
-            out = 1.0 / (1.0 + np.exp(-out))
-        return out
+        with _trace.request_span("serve.stage.score", cat="serve",
+                                 keys=len(packed.keys["w"])):
+            cap_w = _cap(len(packed.keys["w"]))
+            cap_v = _cap(len(packed.keys["V"]))
+            margin = _fm_margin(
+                jnp.asarray(packed.seg), jnp.asarray(packed.remap["w"]),
+                jnp.asarray(packed.remap["V"]), jnp.asarray(packed.val),
+                jnp.asarray(_padded(rows["w"], cap_w)),
+                jnp.asarray(_padded(rows["cnt"], cap_w)),
+                jnp.asarray(_padded(rows["V"], cap_v)),
+                num_rows=cfg.minibatch, threshold=int(cfg.threshold),
+                l1_shrk=bool(cfg.l1_shrk))
+            out = np.asarray(margin)[: packed.size]
+            if getattr(cfg, "prob_predict", False):
+                out = 1.0 / (1.0 + np.exp(-out))
+            return out

@@ -135,6 +135,7 @@ class _ServeHandler(socketserver.StreamRequestHandler):
             if got is None:
                 return
             header, arrays, _ = got
+            t_in = time.perf_counter()
             # WH_NET_MAX_INFLIGHT admission gate, same contract as the
             # PS shards: a bounced frame was never dispatched, so the
             # client resends the SAME seq and the reply cache keeps the
@@ -144,7 +145,11 @@ class _ServeHandler(socketserver.StreamRequestHandler):
                            dict(busy_reply(), version=srv.version))
                 continue
             try:
-                resp_header, resp_arrays = srv._dispatch(header, arrays)
+                # adopt the trace context a sampled request carried, so
+                # this shard's spans stitch under the router's fan-out
+                with _trace.bind_wire(header):
+                    resp_header, resp_arrays = srv._dispatch(
+                        header, arrays, t_in)
             finally:
                 srv._gate.leave()
             send_frame(self.wfile, resp_header, resp_arrays)
@@ -281,11 +286,24 @@ class ModelServer:
 
     # -- ops ----------------------------------------------------------------
     def _dispatch(self, header: dict,  # wormlint: thread-entry
-                  arrays: dict) -> tuple[dict, dict]:
+                  arrays: dict,
+                  t_in: Optional[float] = None) -> tuple[dict, dict]:
         op = header.get("op")
         t0 = time.perf_counter()
         try:
-            return self._dispatch_op(op, header, arrays)
+            with _trace.request_span(f"serve.shard.{op}", cat="serve",
+                                     rank=self.rank):
+                resp = self._dispatch_op(op, header, arrays)
+            if op == "fetch" and "queue_s" not in resp[0] \
+                    and "error" not in resp[0]:
+                # stage attribution for the router: how long the frame
+                # waited behind the gate/handler, and how long the fetch
+                # itself took. A cached (retried) reply keeps the
+                # ORIGINAL numbers — same bytes as the first send.
+                resp[0]["queue_s"] = round(
+                    t0 - t_in, 6) if t_in is not None else 0.0
+                resp[0]["served_s"] = round(time.perf_counter() - t0, 6)
+            return resp
         except Exception as e:  # a bad request must not kill the shard
             return {"error": repr(e), "version": self.version}, {}
         finally:
