@@ -1,0 +1,235 @@
+"""The unified retry policy (runtime/retry.py) and the partition-grade
+network faults it is budgeted against (runtime/faults.py).
+
+Every dial/redial loop in the tree draws its sleeps from a RetryBudget:
+deadline fixed at construction, exponential backoff with full jitter,
+success/give-up counted into the policy-wide `retry.*` metrics the
+chaos drills pin (give_ups == 0 across a healed partition).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime.retry import RetryBudget, RetryPolicy, connect
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_faults():
+    """Tests install Faults objects directly; never leak one."""
+    prev = faults.ACTIVE
+    faults.ACTIVE = None
+    yield
+    faults.ACTIVE = prev
+
+
+def _counter(name):
+    return _obs.REGISTRY.counter(name).value()
+
+
+# -- RetryBudget --------------------------------------------------------------
+
+def test_budget_deadline_and_expiry():
+    b = RetryBudget(0.05, base_s=0.001, cap_s=0.001)
+    assert not b.expired
+    assert 0.0 < b.remaining <= 0.05
+    time.sleep(0.06)
+    assert b.expired
+    assert b.remaining <= 0.0
+
+
+def test_backoff_doubles_to_cap(monkeypatch):
+    slept = []
+    monkeypatch.setattr(time, "sleep", slept.append)
+    # random() pinned to 0.5 makes the jittered step equal the raw step
+    monkeypatch.setattr("wormhole_tpu.runtime.retry.random.random",
+                        lambda: 0.5)
+    b = RetryBudget(1000.0, base_s=0.1, cap_s=0.4)
+    for _ in range(4):
+        b.sleep()
+    assert slept == pytest.approx([0.1, 0.2, 0.4, 0.4])
+    assert b.attempts == 4
+
+
+def test_sleep_never_passes_deadline(monkeypatch):
+    slept = []
+    monkeypatch.setattr(time, "sleep", slept.append)
+    b = RetryBudget(0.05, base_s=10.0, cap_s=10.0)
+    dur = b.sleep()
+    assert dur <= 0.05
+    assert all(s <= 0.05 for s in slept)
+
+
+def test_sleep_honors_hint(monkeypatch):
+    """A busy reply's retry_ms overrides the exponential step (jittered
+    0.5x-1.5x), without disturbing the backoff progression."""
+    slept = []
+    monkeypatch.setattr(time, "sleep", slept.append)
+    b = RetryBudget(1000.0, base_s=1.0, cap_s=8.0)
+    b.sleep(hint_s=0.01)
+    assert 0.005 <= slept[0] <= 0.015
+
+
+def test_give_up_counts_and_raises():
+    g0 = _counter("retry.give_ups")
+    b = RetryBudget(0.0, op="test-op")
+    with pytest.raises(TimeoutError, match="test-op"):
+        b.give_up()
+    err = OSError("original failure")
+    with pytest.raises(OSError, match="original failure"):
+        b.give_up(err)
+    assert _counter("retry.give_ups") == g0 + 2
+
+
+def test_succeeded_counts_only_after_retries():
+    s0 = _counter("retry.successes")
+    b = RetryBudget(1.0, base_s=0.001, cap_s=0.001)
+    b.succeeded()  # first-try success: not a retry success
+    assert _counter("retry.successes") == s0
+    b.sleep()
+    b.succeeded()
+    assert _counter("retry.successes") == s0 + 1
+
+
+def test_policy_mints_fresh_budgets():
+    p = RetryPolicy(deadline_s=5.0, base_s=0.01, cap_s=0.1, op="dial")
+    b = p.budget()
+    assert b.op == "dial"
+    assert 4.5 < b.remaining <= 5.0
+    assert p.budget(deadline_s=0.0).expired
+
+
+# -- connect() ----------------------------------------------------------------
+
+def test_connect_dials_listener():
+    srv = socket.create_server(("127.0.0.1", 0))
+    try:
+        s = connect(srv.getsockname(), deadline_s=5.0)
+        assert s.getsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY)
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_connect_retries_then_gives_up():
+    # grab a port with no listener: every dial is refused
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    addr = probe.getsockname()
+    probe.close()
+    retries = []
+    g0 = _counter("retry.give_ups")
+    with pytest.raises(OSError):
+        connect(addr, deadline_s=0.2, op="test-dial",
+                on_retry=lambda: retries.append(1))
+    assert retries  # per-failure hook fired
+    assert _counter("retry.give_ups") == g0 + 1
+
+
+def test_connect_succeeds_mid_retry():
+    """The budget rides out a listener that comes up late — the healed-
+    partition shape: refused dials retry, then traffic flows, with zero
+    give-ups."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    addr = probe.getsockname()
+    probe.close()
+    srv_box = []
+
+    def bind_late():
+        time.sleep(0.3)
+        srv_box.append(socket.create_server(addr))
+
+    t = threading.Thread(target=bind_late, daemon=True)
+    t.start()
+    g0 = _counter("retry.give_ups")
+    s = connect(addr, deadline_s=10.0)
+    s.close()
+    t.join()
+    srv_box[0].close()
+    assert _counter("retry.give_ups") == g0
+
+
+# -- partition/slow faults ----------------------------------------------------
+
+def test_partition_blocks_then_heals():
+    f = faults.Faults("net:partition@push:0.3", role="worker")
+    with pytest.raises(OSError, match="net:partition"):
+        f.frame("push")  # first matching send arms the window
+    f.frame("pull")  # other ops unaffected
+    with pytest.raises(OSError):
+        f.frame("push")
+    time.sleep(0.35)
+    f.frame("push")  # healed: disarmed for good
+    f.frame("push")
+
+
+def test_partition_any_matches_every_op():
+    f = faults.Faults("net:partition@any:0.2", role="worker")
+    with pytest.raises(OSError):
+        f.frame("push")
+    with pytest.raises(OSError):
+        f.frame("pull")
+    time.sleep(0.25)
+    f.frame("pull")
+
+
+def test_partition_does_not_arm_on_servers():
+    f = faults.Faults("net:partition@push:5", role="server")
+    f.frame("push")  # net faults are worker/role-less only
+
+
+def test_slow_sleeps_per_send():
+    f = faults.Faults("net:slow@pull:30", role="worker")
+    t0 = time.monotonic()
+    f.frame("pull")
+    assert time.monotonic() - t0 >= 0.03
+    t0 = time.monotonic()
+    f.frame("push")  # other ops at full speed
+    assert time.monotonic() - t0 < 0.02
+
+
+def test_slow_prints_arm_line_once(capsys):
+    """chaos_lab's fault_fired check scrapes '[faults] injecting' from
+    stdout; the slow fault must announce itself (exactly once)."""
+    f = faults.Faults("net:slow@any:1", role="worker")
+    f.frame("push")
+    f.frame("push")
+    out = capsys.readouterr().out
+    assert out.count("[faults] injecting net slow") == 1
+
+
+@pytest.mark.parametrize("spec", [
+    "net:partition@push",       # missing secs
+    "net:partition@:5",         # missing op
+    "net:partition@push:0",     # non-positive window
+    "net:slow@pull:-1",         # non-positive delay
+    "net:bogus:1",
+])
+def test_bad_fault_specs_rejected(spec):
+    with pytest.raises(faults.FaultSpecError):
+        faults.Faults(spec, role="worker")
+
+
+def test_budget_rides_out_partition():
+    """The contract every converted loop follows, end to end: a 0.25s
+    partition against a 5s budget ends in success with give_ups
+    untouched."""
+    f = faults.Faults("net:partition@push:0.25", role="worker")
+    budget = RetryBudget(5.0, base_s=0.02, cap_s=0.05, op="push")
+    g0 = _counter("retry.give_ups")
+    while True:
+        try:
+            f.frame("push")
+            budget.succeeded()
+            break
+        except OSError as e:
+            if budget.expired:
+                budget.give_up(e)
+            budget.sleep()
+    assert budget.attempts >= 1
+    assert _counter("retry.give_ups") == g0

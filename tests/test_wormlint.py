@@ -320,6 +320,76 @@ def test_thread_lifecycle_accepts_daemon_join_or_annotation():
         assert _lint(src, only=["thread-lifecycle"]) == []
 
 
+# --- retry-policy -----------------------------------------------------------
+
+_ROLLED_RETRY = """\
+    import time
+
+    def fetch(client):
+        while True:
+            try:
+                return client.call(op="pull")
+            except (OSError, ConnectionError):
+                time.sleep(0.5)
+    """
+
+_NAKED_DIAL = """\
+    import socket
+
+    def dial(addr):
+        return socket.create_connection(addr)
+    """
+
+
+def test_retry_policy_flags_hand_rolled_loop():
+    assert ("retry-policy", "loop:fetch") in _keys(
+        _lint(_ROLLED_RETRY, only=["retry-policy"]))
+
+
+def test_retry_policy_flags_dial_without_timeout():
+    assert ("retry-policy", "dial:dial") in _keys(
+        _lint(_NAKED_DIAL, only=["retry-policy"]))
+
+
+def test_retry_policy_clean_variants():
+    # a timeout (keyword or positional) makes the dial bounded
+    kw = _NAKED_DIAL.replace("create_connection(addr)",
+                             "create_connection(addr, timeout=2.0)")
+    pos = _NAKED_DIAL.replace("create_connection(addr)",
+                              "create_connection(addr, 2.0)")
+    # budget.sleep() is the policy, not a hand-rolled backoff
+    budgeted = _ROLLED_RETRY.replace("time.sleep(0.5)", "budget.sleep()")
+    # a handler that returns/raises/breaks exits the loop: error
+    # reporting, not a retry (obs_top's watch loop has this shape —
+    # the sleep is the refresh cadence, the handler bails)
+    bail = """\
+        import time
+
+        def watch(client):
+            while True:
+                try:
+                    got = client.call(op="metrics")
+                except (OSError, ConnectionError):
+                    return None
+                print(got)
+                time.sleep(2.0)
+        """
+    for src in (kw, pos, budgeted, bail):
+        assert _lint(src, only=["retry-policy"]) == [], src
+
+
+def test_retry_policy_exempts_policy_module():
+    assert _lint(_ROLLED_RETRY, path="wormhole_tpu/runtime/retry.py",
+                 only=["retry-policy"]) == []
+
+
+def test_retry_policy_disable_comment():
+    suppressed = _ROLLED_RETRY.replace(
+        "while True:",
+        "while True:  # wormlint: disable=retry-policy")
+    assert _lint(suppressed, only=["retry-policy"]) == []
+
+
 # --- suppression ------------------------------------------------------------
 
 def test_disable_comment_suppresses_finding():

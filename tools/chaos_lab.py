@@ -54,10 +54,26 @@ mid-push (snapshot restore + journal replay), a server killed mid-pull
 (fenced RPC retry without any server death), and injected latency (no
 fault, just slowness — must stay bit-identical survived).
 
+A third lab rides the same harness:
+
+  --elastic    the elastic-membership drill (docs/distributed.md): a
+               difacto job launched with `--elastic` under scripted
+               churn (WH_ELASTIC_PLAN join@/leave@), a partition that
+               must heal, and a degraded link. Every scenario must
+               converge to parity with the fixed-world baseline; churn
+               scenarios must show the membership machinery in the run
+               report (`membership_epochs`/`worker_joins`/
+               `worker_leaves` > 0), every scenario must end with
+               `retry_give_ups == 0` (the unified retry policy rode
+               the fault out), and the churn drill runs with a `--serve
+               1` tier plus an in-process router driver that must see
+               ZERO failed predict requests throughout.
+
 Usage:
   JAX_PLATFORMS=cpu python tools/chaos_lab.py
   python tools/chaos_lab.py --specs "server:0:kill@push:30" --restarts 2
   python tools/chaos_lab.py --no-recovery   # verify fail-fast still fails
+  python tools/chaos_lab.py --elastic      # membership churn drill
 
 Each scenario is a fresh launcher subprocess, so a hard server exit
 (os._exit in runtime/faults.py) is a real process death — the same
@@ -131,6 +147,24 @@ BSP_JOBS = [
 _BSP_METRIC_KEYS = ("bsp_recoveries", "bsp_ring_retries",
                     "bsp_result_fetches", "bsp_rounds",
                     "bsp_checkpoints", "connect_retries")
+
+# --elastic matrix: (name, WH_ELASTIC_PLAN, fault spec, serve drill).
+# Plan offsets are seconds from scheduler start; the 6-pass 2-worker
+# job runs ~20s, so join@4 lands mid-pass-1 and leave@13 mid-run with
+# passes still to go — the re-pinned parts and the shrunk set both
+# have to produce real work after the epoch bump.
+ELASTIC_SCENARIOS = [
+    ("join@4s", "join@4", "", False),
+    ("leave@4s", "leave@4", "", False),
+    ("churn+serve", "join@4,leave@13", "", True),
+    ("partition-heal", "", "net:partition@push:5", False),
+    ("slow-link", "", "net:slow@pull:10", False),
+]
+
+_ELASTIC_METRIC_KEYS = ("membership_epochs", "worker_joins",
+                        "worker_leaves", "ps_rehellos", "retry_attempts",
+                        "retry_successes", "retry_give_ups", "ps_retries",
+                        "liveness_evictions")
 
 
 def synth_libsvm(path: str, n_rows: int, seed: int, n_feat: int = 1000,
@@ -383,6 +417,254 @@ def bsp_matrix(args) -> int:
     return worst if worst != 1 else 1
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _predict_block(rng, rows: int, nnz: int):
+    """One synthetic predict batch (serve_lab's recipe): raw 62-bit
+    feature ids; DifactoScorer's pack hashes them into buckets exactly
+    as the trainer's loader does."""
+    from wormhole_tpu.data.rowblock import RowBlock
+
+    counts = rng.integers(max(nnz // 2, 1), nnz + 1, size=rows)
+    offset = np.zeros(rows + 1, np.int64)
+    offset[1:] = np.cumsum(counts)
+    return RowBlock(
+        label=np.zeros(rows, np.float32),
+        offset=offset,
+        index=rng.integers(0, 1 << 62, size=int(offset[-1]),
+                           dtype=np.int64).astype(np.uint64),
+        value=(rng.random(int(offset[-1])).astype(np.float32) + 0.5),
+    )
+
+
+def _serve_driver(sched_uri: str, stop, stats: dict) -> None:
+    """Closed-loop predict load against the job's --serve tier for the
+    whole churn window. The acceptance bar is ZERO failed requests:
+    worker joins/leaves, snapshot swaps, and part re-pins must never be
+    visible to the serving path."""
+    from wormhole_tpu.models.difacto import DifactoConfig
+    from wormhole_tpu.runtime.tracker import SchedulerClient
+    from wormhole_tpu.serving import DifactoScorer, Router
+
+    cfg = DifactoConfig(minibatch=64, num_buckets=16384, v_buckets=4096,
+                        dim=4, nnz_per_row=16)
+    rng = np.random.default_rng(7)
+    blocks = [_predict_block(rng, 64, 8) for _ in range(4)]
+    try:
+        router = Router.from_scheduler(
+            SchedulerClient(sched_uri, "chaos-serve-driver"),
+            DifactoScorer(cfg), world=1, timeout=90.0)
+    except Exception as e:  # the verdict reports it; don't kill the lab
+        stats["error"] = f"router never came up: {e}"
+        return
+    try:
+        while not stop.wait(0.25):
+            try:
+                router.predict_block(blocks[stats["requests"]
+                                            % len(blocks)])
+                stats["requests"] += 1
+            except Exception as e:
+                stats["failures"] += 1
+                stats.setdefault("error", str(e))
+    finally:
+        router.close()
+
+
+def run_elastic_job(conf: str, plan: str, spec: str, workers: int,
+                    servers: int, timeout: float, obs_dir: str,
+                    serve: bool = False
+                    ) -> tuple[int, str, float, dict | None, dict]:
+    """One `--elastic` launcher run; with serve=True the scheduler port
+    is pinned (WH_SCHED_PORT) and a router driver thread fires predict
+    batches at the --serve tier for the duration."""
+    import threading
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    for k in ("WH_FAULT_SPEC", "WH_OBS_DIR", "WH_ELASTIC_PLAN",
+              "WH_SCHED_PORT"):
+        env.pop(k, None)
+    env["WH_ASYNC_SYNC"] = "1"
+    env["WH_KEYCACHE"] = "1"
+    # a 1s controller/supervisor cadence so plan offsets land sharply,
+    # and a retry window that spans the 5s partition with headroom
+    env["WH_ELASTIC_SEC"] = "1"
+    env["WH_PS_RETRY_SEC"] = "30"
+    if plan:
+        env["WH_ELASTIC_PLAN"] = plan
+    if spec:
+        env["WH_FAULT_SPEC"] = spec
+    os.makedirs(obs_dir, exist_ok=True)
+    env["WH_OBS_DIR"] = obs_dir
+    argv = [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+            "-n", str(workers), "-s", str(servers),
+            "--node-timeout", "10", "--elastic"]
+    stats = {"requests": 0, "failures": 0}
+    port = None
+    if serve:
+        port = _free_port()
+        env["WH_SCHED_PORT"] = str(port)
+        argv += ["--serve", "1"]
+    argv += ["--", sys.executable, "-m", "wormhole_tpu.apps.difacto",
+             conf]
+    t0 = time.monotonic()
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=env, cwd=REPO)
+    stop = threading.Event()
+    driver = None
+    if serve:
+        driver = threading.Thread(
+            target=_serve_driver, args=(f"127.0.0.1:{port}", stop, stats),
+            daemon=True)
+        driver.start()
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    finally:
+        stop.set()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    if driver is not None:
+        driver.join(timeout=30)
+    report = None
+    try:
+        with open(os.path.join(obs_dir, "run_report.json")) as fh:
+            report = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass  # a crashed run may not get as far as the report
+    return proc.returncode, out, time.monotonic() - t0, report, stats
+
+
+def elastic_matrix(args) -> int:
+    """The --elastic lab: a fixed-world logloss baseline, then the
+    ELASTIC_SCENARIOS churn/partition/slow matrix. Each scenario must
+    (a) exit clean and converge within --tol of the baseline, (b) show
+    the machinery it exercises in the run report (membership epochs for
+    churn, fired faults + retry attempts for partitions), and (c) end
+    with retry_give_ups == 0 — a bounded-retry policy that gave up
+    somewhere is a failure even when the job limps to a clean exit."""
+    workers = args.workers or 2
+    scratch = tempfile.mkdtemp(prefix="wh_chaos_elastic_")
+    for i in range(2):
+        synth_libsvm(os.path.join(scratch, f"train-{i}.libsvm"),
+                     args.rows, seed=i)
+    synth_libsvm(os.path.join(scratch, "val.libsvm"), args.rows, seed=9)
+    conf = os.path.join(scratch, "chaos.conf")
+    # enough passes (~20s of run) for the plan offsets to land mid-run
+    # with real work remaining on both sides of each epoch bump
+    passes = max(args.passes, 6)
+    with open(conf, "w") as fh:
+        fh.write(f"""
+train_data = "{scratch}/train-.*"
+val_data = "{scratch}/val.libsvm"
+algo = ftrl
+dim = 4
+threshold = 2
+lambda_l1 = 0.5
+minibatch = 128
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = {passes}
+max_delay = 1
+""")
+    print(f"[chaos] stack=elastic scratch={scratch} workers={workers} "
+          f"servers={args.servers}")
+
+    rc, out, dt, base_report = run_job(
+        conf, "", workers, args.servers, 0, args.timeout,
+        obs_dir=os.path.join(scratch, "obs-baseline"))
+    base = final_logloss(out)
+    if rc != 0 or base is None:
+        print(out[-4000:])
+        print(f"[chaos] baseline (fixed world) FAILED rc={rc} — nothing "
+              "to compare against; fix the clean path first")
+        return 2
+    base_m = report_metrics(base_report, _ELASTIC_METRIC_KEYS)
+    print(f"[chaos] baseline: logloss={base:.5f} ({dt:.0f}s)")
+
+    rows, worst = [], 0
+    for i, (name, plan, spec, serve) in enumerate(ELASTIC_SCENARIOS):
+        rc, out, dt, report, stats = run_elastic_job(
+            conf, plan, spec, workers, args.servers, args.timeout,
+            os.path.join(scratch, f"obs-{i}"), serve=serve)
+        ll = final_logloss(out)
+        m = report_metrics(report, _ELASTIC_METRIC_KEYS)
+        if rc != 0 or ll is None:
+            verdict, detail = "FAILED", f"rc={rc} logloss={ll}"
+            worst = max(worst, 1)
+            tail = "\n".join(out.splitlines()[-12:])
+            detail += "\n    " + tail.replace("\n", "\n    ")
+        elif abs(ll - base) > args.tol:
+            verdict = "SILENT-CORRUPTION"
+            detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            worst = max(worst, 3)
+        else:
+            verdict = "survived"
+            detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            problems = []
+            if report is None:
+                problems.append("no run_report.json")
+            else:
+                if m["retry_give_ups"] > 0:
+                    problems.append(
+                        f"retry_give_ups={m['retry_give_ups']}")
+                if plan and m["membership_epochs"] < 1:
+                    problems.append("no membership epoch bump")
+                if "join" in plan and m["worker_joins"] < 1:
+                    problems.append("no worker join observed")
+                if "leave" in plan and m["worker_leaves"] < 1:
+                    problems.append("no worker leave observed")
+            if spec and not fault_fired(out):
+                problems.append("fault never fired")
+            if spec.startswith("net:partition") and report is not None \
+                    and m["retry_attempts"] < 1:
+                # the partition fired yet nothing retried: the window
+                # closed between sends, proving nothing about the policy
+                problems.append("no retry attempts under partition")
+            if serve:
+                if stats.get("error") and stats["requests"] == 0:
+                    problems.append(stats["error"])
+                elif stats["requests"] < 1:
+                    problems.append("serve driver issued no requests")
+                elif stats["failures"] > 0:
+                    problems.append(
+                        f"{stats['failures']} failed serve requests")
+            if problems:
+                verdict = f"survived ({'; '.join(problems)}!)"
+                worst = max(worst, 1)
+        deltas = metric_deltas(m, base_m, _ELASTIC_METRIC_KEYS) \
+            if report is not None else "(no run_report.json)"
+        serve_note = (f", serve {stats['requests']} ok /"
+                      f" {stats['failures']} failed" if serve else "")
+        rows.append((name, verdict, detail, dt, deltas))
+        print(f"[chaos] {name}: {verdict} ({detail.splitlines()[0]}"
+              f"{serve_note}, {dt:.0f}s)")
+        if verdict == "FAILED":
+            # the tail is the only diagnostic a failed run leaves behind
+            print("\n".join(f"[chaos]   {l}"
+                            for l in detail.splitlines()[1:]))
+        print(f"[chaos]   metrics vs baseline: {deltas}")
+        print(f"[chaos]   {slo_burn_line(report)}")
+
+    print(f"\n{'scenario':<22} {'verdict':<44} {'sec':>5}")
+    for name, verdict, detail, dt, deltas in rows:
+        print(f"{name:<22} {verdict:<44} {dt:>5.0f}")
+        print(f"    {detail.splitlines()[0]}")
+        print(f"    {deltas}")
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+    return worst if worst != 1 else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fault-injection matrix for the recovery paths")
@@ -408,6 +690,13 @@ def main(argv=None) -> int:
                          "server group demoted to a flush-barrier cold "
                          "tier; forces workers=1 and a 4-device host "
                          "mesh, and uses the HOT_SPECS fault matrix)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic-membership drill instead of a "
+                         "fault matrix: scripted join/leave churn, a "
+                         "healing partition, and a slow link, each "
+                         "judged on convergence parity + membership/"
+                         "retry metrics (and a --serve tier that must "
+                         "drop zero predict requests during churn)")
     ap.add_argument("--sync-mode", action="store_true",
                     help="run with WH_ASYNC_SYNC=0 WH_KEYCACHE=0 (the "
                          "pre-overlap synchronous plane); default is "
@@ -429,6 +718,8 @@ def main(argv=None) -> int:
                     help="keep the scratch dir (data + confs)")
     args = ap.parse_args(argv)
 
+    if args.elastic:
+        return elastic_matrix(args)
     if args.stack == "bsp":
         return bsp_matrix(args)
     if args.plane == "hot":

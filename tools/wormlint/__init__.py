@@ -1,7 +1,8 @@
 """wormlint: AST static analysis for wormhole-tpu's bug classes.
 
-Five checkers over ``wormhole_tpu/``, ``tools/`` and ``bench.py``:
-lock-discipline, env-knobs, metric-names, jit-purity, thread-lifecycle.
+Six checkers over ``wormhole_tpu/``, ``tools/`` and ``bench.py``:
+lock-discipline, env-knobs, metric-names, jit-purity, thread-lifecycle,
+retry-policy.
 See docs/static_analysis.md and ``python -m tools.wormlint --help``.
 """
 
@@ -9,7 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from . import jitpure, knobs, locks, metricnames, threads
+from . import jitpure, knobs, locks, metricnames, retrypolicy, threads
 from .core import (CHECKERS, FileSource, Finding, apply_suppressions,
                    load_baseline, load_files, match_baseline, save_baseline)
 
@@ -37,6 +38,8 @@ def run_checks(files: list[FileSource],
         findings.extend(jitpure.check(files))
     if want(threads.CHECKER):
         findings.extend(threads.check(files))
+    if want(retrypolicy.CHECKER):
+        findings.extend(retrypolicy.check(files))
     findings = apply_suppressions(files, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.checker, f.key))
     return findings

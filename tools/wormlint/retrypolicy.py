@@ -1,0 +1,125 @@
+"""retry-policy: network retries go through ``runtime/retry.py``.
+
+Scattered hand-rolled retry loops each re-invent backoff, deadlines and
+give-up accounting — and each forgets one of them differently. The
+unified ``RetryBudget`` owns all three and emits the ``retry.*``
+metrics, so this checker flags the two patterns that bypass it:
+
+- a blocking socket dial (``socket.create_connection(...)``) with no
+  ``timeout`` argument — it can hang forever on a partitioned link,
+  outside any deadline budget;
+- a hand-rolled retry loop: a ``while`` whose body has a ``try`` that
+  catches a network error (OSError / ConnectionError / TimeoutError /
+  socket.error) without leaving the loop, *and* sleeps via
+  ``time.sleep`` — backoff belongs in ``RetryBudget.sleep()``. Handlers
+  that provably exit (``return`` / ``raise`` / ``break``) don't count:
+  that's error reporting, not a retry.
+
+``runtime/retry.py`` itself is exempt (it *is* the policy), and a
+``# wormlint: disable=retry-policy`` directive on the dial or the
+``while`` line suppresses either pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import FileSource, Finding, dotted_name, terminal_name
+
+CHECKER = "retry-policy"
+
+_NET_ERRORS = {"OSError", "ConnectionError", "ConnectionResetError",
+               "ConnectionRefusedError", "BrokenPipeError", "TimeoutError",
+               "socket.error", "socket.timeout", "error", "timeout"}
+
+
+def _is_policy_module(path: str) -> bool:
+    return path.replace("\\", "/").endswith("runtime/retry.py")
+
+
+def _enclosing_func(parents: dict, node: ast.AST) -> str:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = parents.get(cur)
+    return "<module>"
+
+
+def _dial_without_timeout(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name is None or terminal_name(call.func) != "create_connection":
+        return False
+    # socket.create_connection(addr[, timeout]): positional #2 or keyword.
+    if len(call.args) >= 2:
+        return False
+    return not any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _catches_net_error(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:` swallows network errors too
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        d = dotted_name(n)
+        if d in _NET_ERRORS or (d and d.split(".")[-1] in _NET_ERRORS):
+            return True
+    return False
+
+
+def _handler_retries(handler: ast.ExceptHandler) -> bool:
+    """False when the handler provably leaves the loop (return/raise/break)."""
+    last = handler.body[-1] if handler.body else None
+    return not isinstance(last, (ast.Return, ast.Raise, ast.Break))
+
+
+def _loop_rolls_retry(loop: ast.While) -> Optional[int]:
+    """Line of the offending ``time.sleep`` if the loop hand-rolls retry."""
+    catches = False
+    sleep_line = None
+    for node in ast.walk(loop):
+        if isinstance(node, ast.ExceptHandler) and _catches_net_error(node) \
+                and _handler_retries(node):
+            catches = True
+        elif isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d in ("time.sleep", "sleep"):
+                sleep_line = node.lineno
+    return sleep_line if (catches and sleep_line is not None) else None
+
+
+def check(files: list[FileSource]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in files:
+        if _is_policy_module(src.path):
+            continue
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and _dial_without_timeout(node):
+                func = _enclosing_func(parents, node)
+                findings.append(Finding(
+                    CHECKER, src.path, node.lineno,
+                    key=f"dial:{func}",
+                    message=("socket.create_connection without a timeout "
+                             "can block forever on a partitioned link — "
+                             "pass a timeout or dial via "
+                             "runtime.retry.connect()")))
+            elif isinstance(node, ast.While):
+                sleep_line = _loop_rolls_retry(node)
+                if sleep_line is None:
+                    continue
+                func = _enclosing_func(parents, node)
+                findings.append(Finding(
+                    CHECKER, src.path, node.lineno,
+                    key=f"loop:{func}",
+                    message=(f"hand-rolled retry loop (catches a network "
+                             f"error and time.sleep()s at line "
+                             f"{sleep_line}) — use "
+                             f"runtime.retry.RetryBudget for backoff, "
+                             f"deadline and give-up accounting")))
+    return findings

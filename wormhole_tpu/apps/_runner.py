@@ -29,7 +29,7 @@ import os
 import sys
 import time
 
-from wormhole_tpu.config import load_config
+from wormhole_tpu.config import knob_value, load_config
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import report as _report
 from wormhole_tpu.obs import trace as _trace
@@ -150,6 +150,8 @@ def _run_scheduler_bsp(env) -> None:
     report; bounded startup so a mis-launched job fails loudly."""
     sched = Scheduler.from_env(env)
     sched.serve()
+    if knob_value("WH_ELASTIC"):
+        sched.start_membership_controller(env.num_workers)
     startup_deadline = time.monotonic() + max(60.0, sched.node_timeout * 4)
     try:
         seen_any = False
@@ -411,6 +413,12 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
     save the final model at job end."""
     sched = Scheduler.from_env(env)
     sched.serve()
+    if knob_value("WH_ELASTIC"):
+        # elastic membership: scripted churn (WH_ELASTIC_PLAN) or
+        # gauge-driven worker-count control; the launcher's elastic
+        # supervisor turns the published target into spawned joiners,
+        # the scheduler itself marks the shrink side retiring
+        sched.start_membership_controller(env.num_workers)
     t0 = time.time()
     result = {}
     ps = None
@@ -616,6 +624,11 @@ def _run_worker(cfg, env, make_learner, verbose: bool) -> dict:
 
 def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
     pool = RemotePool(client)
+    if knob_value("WH_ELASTIC_JOIN"):
+        # elastic joiner (spawned mid-job by the launcher's supervisor):
+        # announce the join so the scheduler bumps the membership epoch
+        # and rebalances pinned parts over the grown set
+        pool.join()
     if cfg.model_in and env.num_servers == 0:
         # replica mode only: with a server group the SCHEDULER commands
         # the servers to load (the model never crosses the worker wire);
@@ -719,6 +732,16 @@ def _run_worker_body(cfg, env, verbose, learner, client) -> dict:
         result["train" if wtype == WorkType.TRAIN else "val"] = prog
     if synced is not None:
         synced.close()  # drain + stop the async comms thread
+    if pool.retire:
+        # retired by the membership controller: every contribution is
+        # merged (each train part ends in a flush), so resign cleanly —
+        # the scheduler drops us from liveness NOW, re-queues nothing
+        # (we hold no part), and bumps the membership epoch for the
+        # survivors. Tail work (predict) belongs to workers that stay.
+        print(f"[worker-{env.rank}] retiring (membership controller)",
+              flush=True)
+        pool.leave()
+        return result
     if synced is not None and last_train is not None:
         # machine-readable wire accounting (the sparse-PS bench parses
         # this; wire bytes/sync is the measured sparse-wire claim)
@@ -798,6 +821,7 @@ def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass,
     train = wtype == WorkType.TRAIN
     step = learner.train_batch if train else learner.eval_batch
     span_name = "solver.train_step" if train else "solver.eval_step"
+    absorb = getattr(synced, "absorb_membership", None)
     while (got := pool.get()) is not None:
         part_id, f = got
         part_prog: dict = {}
@@ -823,6 +847,12 @@ def _drain_round(solver, learner, pool: RemotePool, wtype, data_pass,
                 synced.flush()
         prog.merge(part_prog)
         pool.finish(part_id, part_prog)
+        if absorb is not None and pool.mepoch:
+            # membership epoch bump observed on the control plane (a
+            # peer joined/left/was evicted): fence + re-handshake the
+            # PS plane at the part boundary — cheap when nothing
+            # changed (absorb_membership no-ops on seen epochs)
+            absorb(pool.mepoch)
     return prog
 
 

@@ -207,6 +207,10 @@ declare_knob("WH_NUM_SERVERS", int, 1,
              "Server count the scheduler waits for.", group="runtime")
 declare_knob("WH_SCHEDULER_URI", str, "",
              "host:port of the scheduler RPC endpoint.", group="runtime")
+declare_knob("WH_SCHED_PORT", int, 0,
+             "Pin the launcher's scheduler RPC port so outside tooling "
+             "(chaos_lab serve driver, obs_top) can dial the job; 0 = "
+             "ephemeral.", group="runtime")
 declare_knob("WH_COORD_URI", str, "",
              "host:port of the coordination endpoint handed to nodes.",
              group="runtime")
@@ -226,6 +230,14 @@ declare_knob("WH_SNAPSHOT_DIR", str, "",
              group="faults")
 declare_knob("WH_PS_RETRY_SEC", float, 0.0,
              "Client-side PS reconnect window in seconds (0 = fail fast).",
+             group="faults")
+declare_knob("WH_RETRY_BASE_SEC", float, 0.05,
+             "Initial backoff step of the unified retry policy "
+             "(runtime/retry.py); each retry doubles it up to "
+             "WH_RETRY_CAP_SEC, with full jitter.", group="faults")
+declare_knob("WH_RETRY_CAP_SEC", float, 1.0,
+             "Backoff ceiling of the unified retry policy; sleeps never "
+             "exceed this (or the budget's remaining deadline).",
              group="faults")
 
 # observability
@@ -330,6 +342,29 @@ declare_knob("WH_BSP_RETRY_SEC", float, 120.0,
              "Total seconds a blocked BSP collective waits for a dead "
              "peer's respawn before failing the job.",
              group="bsp")
+
+# elastic worker membership (tracker join/leave + launcher supervisor)
+declare_knob("WH_ELASTIC", bool, False,
+             "Elastic worker membership: the launcher supervises the worker "
+             "set and spawns/retires workers on scheduler decisions "
+             "(MembershipController or WH_ELASTIC_PLAN).", group="elastic")
+declare_knob("WH_ELASTIC_SEC", float, 5.0,
+             "Cadence of the scheduler's membership-controller loop (and "
+             "the launcher's elastic-decision poll).", group="elastic")
+declare_knob("WH_ELASTIC_MIN", int, 1,
+             "Floor of the elastic worker count; the controller never "
+             "shrinks below it.", group="elastic")
+declare_knob("WH_ELASTIC_MAX", int, 0,
+             "Ceiling of the elastic worker count (0 = twice the launch "
+             "size).", group="elastic")
+declare_knob("WH_ELASTIC_JOIN", bool, False,
+             "Set by the launcher's elastic supervisor on workers it spawns "
+             "mid-job: announce a `join` to the scheduler before taking "
+             "work (internal handshake, not user-facing).", group="elastic")
+declare_knob("WH_ELASTIC_PLAN", str, "",
+             "Scripted membership plan `join@<sec>,leave@<sec>,...` "
+             "(seconds from job start): deterministic churn for drills; "
+             "empty = gauge-driven controller decisions.", group="elastic")
 
 # kernel tuning (WORMHOLE_* block-size overrides for Pallas kernels)
 declare_knob("WORMHOLE_TILE_HI", int, 512,

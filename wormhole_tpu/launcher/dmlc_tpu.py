@@ -94,12 +94,18 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            num_serve: int = 0,
            max_serve_restarts: int = 0,
            snapshot_dir: str | None = None,
+           elastic: bool = False,
            pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
                                         "PYTHONPATH", "WH_PS_PLANE",
                                         "WH_NET_COMPRESS",
                                         "WH_TRACE_SAMPLE",
                                         "WH_OBS_SCRAPE_SEC",
-                                        "WH_OBS_SCRAPE_PORT")) -> int:
+                                        "WH_OBS_SCRAPE_PORT",
+                                        "WH_ELASTIC_SEC", "WH_ELASTIC_MIN",
+                                        "WH_ELASTIC_MAX",
+                                        "WH_ELASTIC_PLAN",
+                                        "WH_RETRY_BASE_SEC",
+                                        "WH_RETRY_CAP_SEC")) -> int:
     """Spawn the scheduler + N workers of `cmd`; stream their output with
     role prefixes; return the first nonzero exit code (0 if all clean).
     On scheduler exit, surviving workers are terminated (the reference
@@ -139,7 +145,17 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     shard exit codes never fold into the job's (the launcher kills
     leftovers at teardown), and `max_serve_restarts > 0` respawns a
     shard that dies mid-job — routers chase the new uri through the
-    scheduler's serve_nodes op."""
+    scheduler's serve_nodes op.
+
+    `elastic=True` makes the WORKER SET itself dynamic: WH_ELASTIC=1 is
+    exported so the scheduler runs its membership controller
+    (WH_ELASTIC_PLAN scripted churn, or gauge-driven sizing), and the
+    launcher runs an elastic supervisor thread that polls the
+    scheduler's `elastic` op — when the target exceeds the live count
+    it spawns fresh worker ranks (WH_ELASTIC_JOIN=1, so they `join` the
+    running job mid-pass); shrinking is the scheduler's half (it marks
+    workers retiring; they drain, flush, `leave`, and exit 0).
+    Local-launch only, like snapshot respawn."""
     multi = bool(hosts)
     recovery = max_server_restarts > 0 and num_servers > 0
     recovery_w = max_worker_restarts > 0 and num_workers > 0
@@ -157,7 +173,11 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
                 "explicitly")
     else:
         sched_host = "127.0.0.1"
-    uri = f"{sched_host}:{_free_port()}"
+    # WH_SCHED_PORT pins the scheduler's RPC port so an outside process
+    # (tools/chaos_lab.py's serve-tier driver, obs_top) can dial the job
+    # without scraping logs; 0/unset keeps the ephemeral default
+    sched_port = int(os.environ.get("WH_SCHED_PORT", "0") or 0)
+    uri = f"{sched_host}:{sched_port or _free_port()}"
     # one run id for the whole job so every node's trace spans and the
     # final report carry the same tag (obs/trace.py reads WH_RUN_ID)
     run_id = os.environ.get("WH_RUN_ID") or f"wh-{int(time.time())}-{os.getpid()}"
@@ -190,6 +210,8 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             env["WH_OBS_DIR"] = obs_dir
         if snapshot_dir:
             env["WH_SNAPSHOT_DIR"] = snapshot_dir
+        if elastic:
+            env["WH_ELASTIC"] = "1"
         if recovery and not os.environ.get("WH_PS_RETRY_SEC"):
             # worker-side retry budget: generous enough to span a server
             # death + respawn + snapshot restore + re-registration; an
@@ -336,6 +358,60 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
                                  daemon=True)
             m.start()
             monitors.append(m)
+
+    if elastic and not multi:
+        # elastic supervisor: the GROW half of the membership loop. The
+        # scheduler decides the target (and handles shrink itself via
+        # retire flags); this thread only turns target > live into
+        # fresh worker processes. Joiners get rank numbers past the
+        # launch set — rank is an identity, not an index.
+        from wormhole_tpu.obs import metrics as _wh_obs
+        from wormhole_tpu.runtime.tracker import SchedulerClient
+
+        _SPAWNS = _wh_obs.REGISTRY.counter("elastic.spawns")
+        _RETIRES = _wh_obs.REGISTRY.counter("elastic.retires")
+        next_rank = [num_workers]
+        seen_retiring: set = set()
+
+        def elastic_loop() -> None:
+            cli = SchedulerClient(uri, node="launcher",
+                                  connect_deadline=node_timeout)
+            poll = max(
+                float(os.environ.get("WH_ELASTIC_SEC", "5") or 5) / 2.0,
+                0.5)
+            while not stop_respawn.wait(poll):
+                try:
+                    r = cli.call(op="elastic")
+                except (OSError, ConnectionError, RuntimeError):
+                    continue  # scheduler busy/gone; next tick decides
+                for n in r.get("retiring", []):
+                    if n not in seen_retiring:
+                        seen_retiring.add(n)
+                        _RETIRES.inc()
+                target = r.get("target")
+                if target is None or r.get("shutdown"):
+                    # once shutdown is announced, workers draining out
+                    # make alive < target look like a deficit; spawning
+                    # into a dying job strands the joiner against a
+                    # scheduler that exits before it can register
+                    continue
+                alive = sum(1 for p in worker_procs.values()
+                            if p.poll() is None)
+                while alive < int(target):
+                    rank = next_rank[0]
+                    next_rank[0] += 1
+                    print(f"[dmlc_tpu] elastic: spawning worker-{rank} "
+                          f"(target {target}, {alive} alive)", flush=True)
+                    p = spawn("worker", rank, {"WH_ELASTIC_JOIN": "1"})
+                    worker_procs[rank] = p
+                    procs[f"worker-{rank}"] = p
+                    watch_output(f"worker-{rank}", p)
+                    _SPAWNS.inc()
+                    alive += 1
+
+        m = threading.Thread(target=elastic_loop, daemon=True)
+        m.start()
+        monitors.append(m)
     try:
         rc = sched.wait()
         stop_respawn.set()  # teardown begins: server exits are expected
@@ -416,6 +492,13 @@ def main(argv=None) -> int:
                     help="directory for the servers' periodic shard "
                          "snapshots (default: a fresh temp dir when "
                          "recovery is on)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="dynamic worker membership: the scheduler "
+                         "sizes the worker set (WH_ELASTIC_PLAN "
+                         "scripted churn or gauge-driven control) and "
+                         "the launcher spawns joining workers; "
+                         "retiring workers drain and leave without a "
+                         "job restart (local launch only)")
     ap.add_argument("-H", "--hosts", default=None,
                     help="comma-separated hosts to spawn role processes "
                          "on via --ssh-cmd (scheduler stays local); "
@@ -473,7 +556,8 @@ def main(argv=None) -> int:
                   max_worker_restarts=args.max_worker_restarts,
                   num_serve=args.num_serve,
                   max_serve_restarts=args.max_serve_restarts,
-                  snapshot_dir=args.snapshot_dir)
+                  snapshot_dir=args.snapshot_dir,
+                  elastic=args.elastic)
 
 
 if __name__ == "__main__":

@@ -71,6 +71,15 @@ COUNTERS: dict[str, str] = {
     "pack_cache.evictions": "LRU evictions from the memory tier",
     "pack_cache.corrupt": "disk entries dropped after checksum failure",
     "obs.scrape.requests": "Prometheus /metrics scrapes served",
+    "retry.attempts": "retried attempts under a deadline-budgeted policy",
+    "retry.give_ups": "retry budgets exhausted (the op failed for good)",
+    "retry.successes": "ops that succeeded after at least one retry",
+    "sched.membership_epochs": "membership epoch bumps (join/leave/eviction)",
+    "sched.joins": "workers admitted into a running job",
+    "sched.leaves": "workers that left a running job cleanly",
+    "elastic.spawns": "worker processes spawned by the elastic supervisor",
+    "elastic.retires": "worker processes retired by the elastic supervisor",
+    "ps.client.rehellos": "PSClient re-hello rounds after a membership bump",
 }
 
 GAUGES: dict[str, str] = {
@@ -111,6 +120,7 @@ HISTOGRAMS: dict[str, str] = {
     "kv.gather_s": "local kvstore gather duration",
     "kv.scatter_s": "local kvstore scatter duration",
     "perf.*_s": "utils.perf mirror of ad-hoc timed ops",
+    "retry.backoff_s": "sleep durations taken between retry attempts",
 }
 
 SPANS: dict[str, str] = {
@@ -145,6 +155,8 @@ EVENTS: dict[str, str] = {
     "sched.serve_recovered": "scheduler accepted a serving-shard re-registration",
     "sched.bsp_recovered": "scheduler accepted a BSP worker re-registration",
     "sched.liveness_evict": "scheduler evicted an unresponsive node",
+    "sched.member_join": "scheduler admitted a worker into a running job",
+    "sched.member_leave": "scheduler processed a worker's clean leave",
 }
 # fmt: on
 

@@ -119,6 +119,13 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "bsp_result_fetches": c.get("bsp.result_fetches", 0),
         "bsp_checkpoints": c.get("bsp.checkpoints", 0),
         "bsp_checkpoint_bytes": c.get("bsp.checkpoint_bytes", 0),
+        "membership_epochs": c.get("sched.membership_epochs", 0),
+        "worker_joins": c.get("sched.joins", 0),
+        "worker_leaves": c.get("sched.leaves", 0),
+        "ps_rehellos": c.get("ps.client.rehellos", 0),
+        "retry_attempts": c.get("retry.attempts", 0),
+        "retry_successes": c.get("retry.successes", 0),
+        "retry_give_ups": c.get("retry.give_ups", 0),
     }
     report = {
         "run_id": run_id or os.environ.get("WH_RUN_ID"),
@@ -205,6 +212,16 @@ def format_lines(report: dict) -> list[str]:
             f"recoveries={s['bsp_recoveries']} "
             f"ring_retries={s['bsp_ring_retries']} "
             f"result_fetches={s['bsp_result_fetches']}")
+    if s.get("membership_epochs"):
+        lines.append(
+            f"  membership: epochs={s['membership_epochs']} "
+            f"joins={s['worker_joins']} leaves={s['worker_leaves']} "
+            f"rehellos={s['ps_rehellos']}")
+    if s.get("retry_attempts") or s.get("retry_give_ups"):
+        lines.append(
+            f"  retry policy: attempts={s['retry_attempts']} "
+            f"successes={s['retry_successes']} "
+            f"give_ups={s['retry_give_ups']}")
     if s.get("keycache_hits") or s.get("keycache_misses") \
             or s.get("keycache_invalidations"):
         lines.append(

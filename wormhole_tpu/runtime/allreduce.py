@@ -59,6 +59,7 @@ from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import retry as _retrylib
 from wormhole_tpu.runtime.net import (connect_with_retry, recv_frame,
                                       send_frame)
 
@@ -191,8 +192,26 @@ class BspWorker:
 
     def _adopt(self, gen: int, uris: list[str]) -> None:
         """Switch to a new membership generation: drop cached peer
-        connections and every mailbox entry of an older generation."""
+        connections and every mailbox entry of an older generation.
+        ELASTIC membership makes the peer list authoritative — a grown
+        or shrunk group re-indexes the ring by list position, so world
+        and rank follow the list (chunk boundaries are functions of
+        (shape, world, rank), so the rebuilt ring is deterministic for
+        the new set). A worker absent from the list has been retired or
+        evicted; it keeps its old identity just long enough to exit."""
         self._uris = uris
+        # re-index BEFORE the same-gen early return: an elastic joiner
+        # learns its (already-bumped) gen from register_bsp's reply, so
+        # its first _wait_group adopt arrives gen-equal but still needs
+        # the authoritative world/rank
+        if self.uri in uris and (len(uris) != self.world
+                                 or uris.index(self.uri) != self.rank):
+            old_r, old_w = self.rank, self.world
+            self.world = len(uris)
+            self.rank = uris.index(self.uri)
+            print(f"[bsp] ring rebuilt at gen {gen}: rank/world "
+                  f"{old_r}/{old_w} -> {self.rank}/{self.world}",
+                  flush=True)
         if gen == self.gen:
             return
         self.gen = gen
@@ -285,21 +304,27 @@ class BspWorker:
                    t: int, chunk: np.ndarray, deadline: float) -> None:
         header = {"op": "bsp_step", "gen": gen, "ver": key[0],
                   "seq": key[1], "t": t, "src": self.rank}
+        pace = min(0.2, self.step_timeout)
+        budget = _retrylib.RetryBudget(
+            max(deadline - time.monotonic(), 0.0),
+            base_s=pace, cap_s=pace, op="bsp.step")
         while True:
             try:
                 self._rpc(to, header, {"x": chunk})
+                budget.succeeded()
                 return
             except OSError:
                 # successor unreachable: either transient or it died. A
                 # death surfaces as a generation bump once its respawn
-                # re-registers; until then keep retrying within budget.
+                # (or the survivors' shrunk ring) re-registers; until
+                # then keep retrying within budget.
                 if self._poll_gen():
                     raise _RoundAbort()
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
+                if budget.expired:
+                    budget.give_up(TimeoutError(
                         f"bsp rank {self.rank}: peer {to} unreachable for "
-                        f"{self.retry_sec:.0f}s (step {t} of {key})")
-                time.sleep(min(0.2, self.step_timeout))
+                        f"{self.retry_sec:.0f}s (step {t} of {key})"))
+                budget.sleep()
 
     def _wait_step(self, gen: int, key: tuple[int, int], t: int,
                    deadline: float) -> np.ndarray:
@@ -377,15 +402,17 @@ class BspWorker:
     def _collective(self, key: tuple[int, int], flat: np.ndarray,
                     combine: Callable) -> np.ndarray:
         attempt_fetch = self._behind
-        deadline = time.monotonic() + self.retry_sec
+        pace = min(0.2, self.step_timeout)
+        budget = _retrylib.RetryBudget(self.retry_sec, base_s=pace,
+                                       cap_s=pace, op="bsp.fetch")
         while True:
             if attempt_fetch:
                 try:
                     got = self._fetch_result(key)
-                except ConnectionError:
-                    if time.monotonic() > deadline:
-                        raise
-                    time.sleep(min(0.2, self.step_timeout))
+                except ConnectionError as e:
+                    if budget.expired:
+                        budget.give_up(e)
+                    budget.sleep()
                     self._poll_gen()
                     continue
                 if got is not None:
@@ -401,7 +428,8 @@ class BspWorker:
                 # and re-ringing a completed round would deadlock.
                 _RING_RETRIES.inc()
                 attempt_fetch = True
-                deadline = time.monotonic() + self.retry_sec
+                budget = _retrylib.RetryBudget(self.retry_sec, base_s=pace,
+                                               cap_s=pace, op="bsp.fetch")
 
     # -- public API ----------------------------------------------------------
     def allreduce(self, x, op: str = "sum") -> np.ndarray:
@@ -437,7 +465,9 @@ class BspWorker:
             out = np.ascontiguousarray(
                 np.asarray(x, np.float32).ravel()).reshape(np.shape(x))
         else:
-            deadline = time.monotonic() + self.retry_sec
+            pace = min(0.1, self.step_timeout)
+            budget = _retrylib.RetryBudget(self.retry_sec, base_s=pace,
+                                           cap_s=pace, op="bsp.broadcast")
             while True:
                 try:
                     h, arrs = self._rpc(root, {"op": "bsp_fetch",
@@ -445,14 +475,15 @@ class BspWorker:
                                                "seq": key[1]})
                     if h.get("hit"):
                         out = np.array(arrs["x"])
+                        budget.succeeded()
                         break
                 except OSError:
                     self._poll_gen()
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
+                if budget.expired:
+                    budget.give_up(TimeoutError(
                         f"bsp rank {self.rank}: broadcast {key} never "
-                        f"published by root {root}")
-                time.sleep(min(0.1, self.step_timeout))
+                        f"published by root {root}"))
+                budget.sleep()
         with self._results_lock:
             self._results[key] = out
         self.seq += 1
@@ -497,6 +528,18 @@ class BspWorker:
         self.seq = 0
         self._behind = True
         return state
+
+    def leave(self) -> None:
+        """Resign from the BSP group (elastic retire): bump the tracker
+        generation so survivors rebuild the ring without this rank at
+        their next round boundary. Best-effort — a crash reaches the
+        same end state through liveness eviction; sends both rank and
+        uri because a re-indexed survivor's rank may no longer match
+        its tracker registration."""
+        try:
+            self.client.call(op="bsp_leave", rank=self.rank, uri=self.uri)
+        except (OSError, ConnectionError):
+            pass
 
     def _ckpt_path(self) -> str:
         return os.path.join(self.snapshot_dir, f"bsp_rank{self.rank}.npz")

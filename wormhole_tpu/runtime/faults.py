@@ -38,6 +38,17 @@ Spec grammar (comma-separated specs; all counters are deterministic):
     net:delay:ms=<K>
         sleep K ms before every request frame send (latency injection).
         Arms in worker/role-less processes only.
+    net:partition@<op>:<secs>
+        link-level partition: starting at the FIRST send of <op> ('any'
+        matches every op), every matching send raises OSError for
+        <secs> seconds, then the link heals and traffic flows again —
+        the shape a retry budget must ride out (bounded retries, no
+        hang, zero give-ups if the budget outlives the partition).
+        Arms in worker/role-less processes only.
+    net:slow@<op>:<ms>
+        slow link: every send of <op> ('any' = all) sleeps <ms> ms
+        first — degraded-but-alive, unlike partition's hard failures.
+        Arms in worker/role-less processes only.
     sched:drop@<op>:<nth>
         the scheduler answers the <nth> request of <op> with an error
         (a dropped/garbled control message). Arms in the scheduler.
@@ -104,6 +115,10 @@ class Faults:
         self._delay_s = 0.0
         self._reset_after: Optional[int] = None
         self._drops: list[tuple[str, int]] = []   # (op, nth)
+        self._partitions: dict[str, float] = {}   # op -> secs
+        self._partition_t0: dict[str, float] = {}  # op -> first-send time
+        self._slows: dict[str, float] = {}        # op -> sleep seconds
+        self._slow_fired = False                  # first-sleep print latch
         net_ok = role not in ("server", "scheduler")
         for raw in spec.split(","):
             s = raw.strip()
@@ -146,6 +161,23 @@ class Faults:
                             f"got {f[2]!r}")
                     if net_ok:
                         self._reset_after = int(f[2][len("after_frames="):])
+                elif f[1].startswith("partition@"):
+                    fop = f[1].split("@", 1)[1]
+                    secs = float(f[2])
+                    if not fop or secs <= 0:
+                        raise FaultSpecError(
+                            f"net:partition: expected "
+                            f"'partition@<op>:<secs>', got {s!r}")
+                    if net_ok:
+                        self._partitions[fop] = secs
+                elif f[1].startswith("slow@"):
+                    fop = f[1].split("@", 1)[1]
+                    ms = float(f[2])
+                    if not fop or ms <= 0:
+                        raise FaultSpecError(
+                            f"net:slow: expected 'slow@<op>:<ms>', got {s!r}")
+                    if net_ok:
+                        self._slows[fop] = ms / 1000.0
                 else:
                     raise FaultSpecError(f"unknown net fault {f[1]!r}")
             elif f[0] == "sched":
@@ -160,6 +192,16 @@ class Faults:
         """Before every request frame send (net faults)."""
         if self._delay_s:
             time.sleep(self._delay_s)
+        if self._slows:
+            d = self._slows.get(op, 0.0) or self._slows.get("any", 0.0)
+            if d:
+                if not self._slow_fired:
+                    self._slow_fired = True
+                    print(f"[faults] injecting net slow on {op!r} "
+                          f"({d * 1000:g}ms/send)", flush=True)
+                time.sleep(d)
+        if self._partitions:
+            self._partition_check(op)
         if self._reset_after is None:
             return
         with self._lock:
@@ -172,6 +214,31 @@ class Faults:
                   f"{self._frames - 1} frames (op {op!r})", flush=True)
             raise ConnectionResetError(
                 f"fault injected: net:reset after {self._frames - 1} frames")
+
+    def _partition_check(self, op) -> None:
+        """Partition window: armed lazily by the first matching send, so
+        '<secs>' measures from when the link is actually exercised, not
+        from process start. While open every matching send fails with
+        OSError; after <secs> the spec is disarmed (healed) and traffic
+        flows again."""
+        with self._lock:
+            for want in list(self._partitions):
+                if want != "any" and want != op:
+                    continue
+                secs = self._partitions[want]
+                t0 = self._partition_t0.get(want)
+                if t0 is None:
+                    t0 = self._partition_t0[want] = time.monotonic()
+                    print(f"[faults] injecting net partition on {want!r} "
+                          f"for {secs:g}s", flush=True)
+                elapsed = time.monotonic() - t0
+                if elapsed < secs:
+                    raise OSError(
+                        f"fault injected: net:partition@{want} "
+                        f"({elapsed:.2f}s/{secs:g}s)")
+                del self._partitions[want]
+                print(f"[faults] net partition on {want!r} healed after "
+                      f"{secs:g}s", flush=True)
 
     def recv(self) -> None:
         """Before every frame receive (reserved for recv-side faults)."""

@@ -49,6 +49,7 @@ import numpy as np
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import retry as _retry
 
 _COMPRESS_MIN = 512  # don't bother compressing tiny buffers
 
@@ -121,28 +122,12 @@ def busy_backoff(header: dict) -> bool:
 
 def connect_with_retry(addr: tuple[str, int], deadline_s: float = 30.0,
                        timeout: float = 60.0) -> socket.socket:
-    """Dial `addr`, retrying refused/unreachable connections with
-    jittered exponential backoff until `deadline_s` elapses."""
-    deadline = time.monotonic() + deadline_s
-    backoff = 0.05
-    while True:
-        try:
-            sock = socket.create_connection(addr, timeout=timeout)
-            # request/response framing on a Nagle'd socket interacts
-            # with delayed ACK: the tail segment of every frame can sit
-            # ~40ms waiting for the peer's ACK, which dwarfs the actual
-            # PS sync work (tools/ps_lab.py measures the difference)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            return sock
-        except OSError:
-            _CONNECT_RETRIES.inc()
-            if time.monotonic() >= deadline:
-                raise
-            # jittered backoff: a respawned server/worker is dialed by
-            # every peer at once, and synchronized retries would keep
-            # arriving as a thundering herd on the fresh listen socket
-            time.sleep(backoff * (0.5 + random.random()))
-            backoff = min(backoff * 2, 1.0)
+    """Dial `addr`, retrying refused/unreachable connections until
+    `deadline_s` elapses.  The loop itself lives in runtime/retry.py
+    (the unified deadline-budgeted policy); this wrapper keeps the
+    historical `net.connect_retries` per-failure counter."""
+    return _retry.connect(addr, deadline_s, timeout,
+                          on_retry=_CONNECT_RETRIES.inc)
 
 
 def _encode(a: np.ndarray, fixed_bytes: int = 0,

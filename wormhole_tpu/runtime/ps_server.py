@@ -76,6 +76,7 @@ import numpy as np
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import retry as _retrylib
 from wormhole_tpu.runtime.net import (  # noqa: F401  (re-exported: the wire
     _COMPRESS_MIN, _decode, _encode, _read_exact, InflightGate,
     busy_backoff, busy_reply, connect_with_retry,
@@ -98,6 +99,9 @@ _RETRIES = _obs.REGISTRY.counter("ps.client.retries")
 _REPLAYS = _obs.REGISTRY.counter("ps.client.replays")
 _REPLAY_DEDUP = _obs.REGISTRY.counter("ps.client.replay_dedup")
 _ROLLBACKS = _obs.REGISTRY.counter("ps.client.rollback_repulls")
+# membership-epoch absorption: re-handshakes run against the (stable)
+# server group after the WORKER set changed (see PSClient.rehello)
+_REHELLOS = _obs.REGISTRY.counter("ps.client.rehellos")
 _SYNCS = _obs.REGISTRY.counter("ps.client.syncs")
 _SYNC_PUSH_S = _obs.REGISTRY.histogram("ps.client.sync_push_s")
 _SYNC_PULL_S = _obs.REGISTRY.histogram("ps.client.sync_pull_s")
@@ -1274,22 +1278,19 @@ class PSClient:
         is available), fence with `hello`, and replay unacked journaled
         pushes. Raises with the resume guidance once `retry_deadline`
         is exhausted."""
-        deadline = time.monotonic() + self.retry_deadline
-        backoff = 0.25
+        budget = _retrylib.RetryBudget(self.retry_deadline, base_s=0.25,
+                                       cap_s=2.0, op="ps.recover")
         print(f"[ps-retry] server {r} ({self.uris[r]}) failed during "
               f"'{op_name}' ({err}); retrying for up to "
               f"{self.retry_deadline:.0f}s", flush=True)
         while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise ConnectionError(
+            if budget.expired:
+                budget.give_up(ConnectionError(
                     f"ps server {self.uris[r]} unreachable during "
                     f"'{op_name}' and did not come back within "
                     f"{self.retry_deadline:.0f}s — the job must be "
-                    "restarted (resume from the last _iter-K checkpoint)"
-                ) from err
-            time.sleep(min(backoff, max(remaining, 0.0)))
-            backoff = min(backoff * 2, 2.0)
+                    "restarted (resume from the last _iter-K checkpoint)"))
+            budget.sleep()
             try:
                 if self.resolver is not None:
                     uris = self.resolver()
@@ -1299,7 +1300,7 @@ class PSClient:
                 host, port = self.uris[r].rsplit(":", 1)
                 s = connect_with_retry(
                     (host, int(port)),
-                    deadline_s=min(2.0, max(remaining, 0.1)))
+                    deadline_s=min(2.0, max(budget.remaining, 0.1)))
                 self._socks[r] = s
                 self._files[r] = s.makefile("rwb")
                 hello: dict = {"op": "hello", "sender": self.sender}
@@ -1355,10 +1356,58 @@ class PSClient:
                 print(f"[ps-retry] server {r} reconnected at "
                       f"{self.uris[r]} (epoch {self._epochs[r]})",
                       flush=True)
+                budget.succeeded()
                 return
             except (OSError, ConnectionError) as e2:
                 self.close(r)
                 err = e2
+
+    def rehello(self, mepoch: Optional[int] = None) -> None:  # wormlint: thread-owned
+        """Absorb a membership-epoch bump: the WORKER set changed (a
+        peer joined or left) while the server group stayed fixed, so the
+        shard map is untouched — but this process may be the one that
+        just came back from a partition, sitting on half-dead sockets
+        whose next frame would ride a stale connection. Re-handshake
+        every server: close, reconnect, hello (latching compression +
+        the server's restore epoch), and replay any journaled pushes the
+        server's `last_seq` reports unapplied. The seq fence makes the
+        replay exactly-once, so calling this when nothing was actually
+        lost is merely a round of hellos."""
+        for r in range(self.world):
+            try:
+                self.close(r)
+                host, port = self.uris[r].rsplit(":", 1)
+                s = connect_with_retry((host, int(port)),
+                                       self.connect_deadline)
+                self._socks[r] = s
+                self._files[r] = s.makefile("rwb")
+                hello: dict = {"op": "hello", "sender": self.sender}
+                if self.net_compress:
+                    hello["net_compress"] = 1
+                h, _, _, _ = self._attempt(r, hello, None, 0, False)
+                self._fc[r] = bool(h.get("net_compress"))
+                self._note_epoch(r, h)
+                _REHELLOS.inc()
+                applied = int(h.get("last_seq", 0))
+                replay = [e for e in self._journal[r] if e[0] > applied]
+                for seq, hdr, arrs, fb, comp in replay:
+                    rh, _, _, _ = self._attempt(r, hdr, arrs, fb, comp)
+                    if "error" in rh:
+                        raise RuntimeError(
+                            f"ps server error on replay: {rh['error']}")
+                    _REPLAYS.inc()
+                    if rh.get("dup"):
+                        _REPLAY_DEDUP.inc()
+                if replay:
+                    print(f"[ps-retry] rehello (mepoch {mepoch}): server "
+                          f"{r} replayed {len(replay)} journaled pushes "
+                          f"(server had seq {applied})", flush=True)
+            except (OSError, ConnectionError) as e:
+                # a dead server here is the ordinary recovery problem,
+                # not a membership one — hand it to the fenced retry
+                if self.retry_deadline <= 0:
+                    raise
+                self._recover(r, "rehello", e)
 
     def close(self, r: Optional[int] = None) -> None:  # wormlint: thread-owned
         ranks = range(self.world) if r is None else [r]
@@ -1718,6 +1767,7 @@ class SyncedStore:
         self._inflight: Optional[dict] = None
         self._comm_q: Optional[queue.Queue] = None
         self._comm_thread: Optional[threading.Thread] = None
+        self._mepoch_seen = 0  # last membership epoch absorbed
         self._rt_wall = 0.0    # round-trip wall summed (comms thread)
         self._wait_wall = 0.0  # fold wait actually paid (train thread)
         self._push_s = 0.0
@@ -2066,6 +2116,27 @@ class SyncedStore:
         if self._steps == 0 and self.num_syncs > 0:
             return
         self._sync_now()
+
+    def absorb_membership(self, mepoch: int) -> bool:
+        """A membership-epoch bump (worker join/leave/evict) reached
+        this worker. Barrier-flush so every local delta is durably
+        merged under the OLD membership, then re-handshake the server
+        group (PSClient.rehello) so a stale connection from a healed
+        partition can't carry pre-bump frames. The servers themselves
+        are membership-stable — only the WORKER set changed — so this
+        is a fence + freshness barrier, not a reshard. Returns True
+        when a bump was actually absorbed; already-seen epochs are a
+        no-op, so callers can invoke this every round unconditionally.
+        Composes with async sync (flush drains the in-flight
+        round-trip first) and with journal replay (rehello replays
+        unacked pushes through the seq fence)."""
+        mepoch = int(mepoch)
+        if mepoch <= self._mepoch_seen:
+            return False
+        self.flush()
+        self.client.rehello(mepoch)
+        self._mepoch_seen = mepoch
+        return True
 
     def close(self) -> None:
         """Stop the comms thread (tests and orderly teardown; it is a

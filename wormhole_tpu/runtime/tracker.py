@@ -39,6 +39,7 @@ from wormhole_tpu.obs import prom as _prom
 from wormhole_tpu.obs import slo as _slo
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import retry as _retry
 from wormhole_tpu.runtime.net import connect_with_retry
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
@@ -50,6 +51,31 @@ _BSP_RECOVERIES = _obs.REGISTRY.counter("bsp.recoveries")
 _BARRIER_WAIT_S = _obs.REGISTRY.histogram("sched.barrier_wait_s")
 _SCRAPES = _obs.REGISTRY.counter("obs.scrape.requests")
 _RING_DEPTH = _obs.REGISTRY.gauge("obs.ring.depth")
+_MEPOCHS = _obs.REGISTRY.counter("sched.membership_epochs")
+_JOINS = _obs.REGISTRY.counter("sched.joins")
+_LEAVES = _obs.REGISTRY.counter("sched.leaves")
+
+
+def _worker_rank(node: str) -> int:
+    """Numeric rank of a `worker-<r>` node name (for retire ordering);
+    unparsable names sort first so they are retired last."""
+    try:
+        return int(node.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return -1
+
+
+def _parse_elastic_plan(spec: str) -> list[tuple[float, int]]:
+    """`join@<sec>,leave@<sec>,...` -> [(at_sec, +1/-1), ...] sorted by
+    time. Unknown verbs raise — a typo'd drill plan must fail loudly."""
+    plan = []
+    for tok in (t.strip() for t in spec.split(",") if t.strip()):
+        verb, _, at = tok.partition("@")
+        if verb not in ("join", "leave") or not at:
+            raise ValueError(f"bad WH_ELASTIC_PLAN token {tok!r} "
+                             "(want join@<sec> or leave@<sec>)")
+        plan.append((float(at), 1 if verb == "join" else -1))
+    return sorted(plan)
 
 
 class Role(str, Enum):
@@ -127,9 +153,20 @@ class Scheduler:
         self.num_serve_recoveries = 0            # shards that re-registered
         self._bsp_uris: dict[int, str] = {}      # bsp worker rank -> uri
         self._bsp_gen = 0                        # membership generation
+        self._bsp_ready = False                  # group fully formed once
         self.num_bsp_recoveries = 0              # workers that re-registered
         self._lock = threading.Lock()
         self._nodes: dict[str, float] = {}       # node -> last seen
+        # elastic membership: the epoch fences stale assignments across
+        # join/leave/eviction; _members guards join idempotence (a
+        # retried join must not double-bump); _retiring holds workers
+        # the controller asked to drain and leave; _elastic_target is
+        # the controller's published worker-count goal
+        self._mepoch = 0
+        self._members: set[str] = set()
+        self._retiring: set[str] = set()
+        self._elastic_target: Optional[int] = None
+        self._elastic_thread: Optional[threading.Thread] = None
         self._barriers: dict[str, set] = {}      # name -> arrived nodes
         self._barrier_gen: dict[str, int] = {}   # name -> generation
         self._epoch = 0                          # bumped per dispatch round
@@ -352,7 +389,57 @@ class Scheduler:
                                             publish=False)
             return out
         if op == "register":
-            return {"ok": True, "epoch": self._epoch}
+            return {"ok": True, "epoch": self._epoch,
+                    "mepoch": self._mepoch}
+        if op == "join":
+            # a worker joining a RUNNING job (elastic membership): admit
+            # it and bump the membership epoch so both planes observe the
+            # change. Idempotent — a joiner retrying its join RPC bumps
+            # only once.
+            with self._lock:
+                fresh = node not in self._members
+                self._members.add(node)
+            if fresh:
+                _JOINS.inc()
+                _trace.event("sched.member_join", cat="membership",
+                             node=node)
+                self.progress.merge({"member_joins": 1.0})
+                self._member_change("join", node)
+            return {"ok": True, "epoch": self._epoch,
+                    "mepoch": self._mepoch}
+        if op == "leave":
+            # a worker resigning cleanly (retired by the controller, or
+            # degrading out of a partition after bounded retries): drop
+            # it from liveness NOW instead of burning a node_timeout,
+            # re-queue anything it still held, and bump the epoch.
+            with self._lock:
+                self._nodes.pop(node, None)
+                self._members.discard(node)
+                self._retiring.discard(node)
+            requeued = self.pool.reset(node)
+            self.pool.drop_node(node)
+            if requeued:
+                print(f"[membership] {node} left holding {requeued} "
+                      "parts; re-queued", flush=True)
+            _LEAVES.inc()
+            _trace.event("sched.member_leave", cat="membership", node=node)
+            with self._lock:
+                self.progress.merge({"member_leaves": 1.0})
+            self._member_change("leave", node)
+            return {"ok": True, "mepoch": self._mepoch}
+        if op == "elastic":
+            # the elastic supervisor's poll (launcher --elastic): read
+            # the controller's current worker-count target and the live
+            # set; a caller may also publish a target here (drills).
+            if req.get("target") is not None:
+                self.set_elastic_target(int(req["target"]))
+            with self._lock:
+                live = sorted(n for n in self._nodes
+                              if n.startswith("worker"))
+                return {"ok": True, "target": self._elastic_target,
+                        "live": live, "retiring": sorted(self._retiring),
+                        "mepoch": self._mepoch,
+                        "shutdown": self._shutdown}
         if op == "register_server":
             # a ps server announces its push/pull endpoint (the ps-lite
             # node-manager rendezvous role). A rank re-registering under
@@ -415,10 +502,18 @@ class Scheduler:
                 prev = self._bsp_uris.get(rank)
                 self._bsp_uris[rank] = req["uri"]
                 recovered = prev is not None and prev != req["uri"]
+                # a rank the formed group has never seen is an ELASTIC
+                # JOIN: bump the generation so survivors rebuild the
+                # ring over the grown peer set at their next version
+                # boundary (before the group first forms, new ranks are
+                # just the initial rendezvous filling up)
+                joined = prev is None and self._bsp_ready
                 if recovered:
                     self._bsp_gen += 1
                     self.num_bsp_recoveries += 1
                     self.progress.merge({"bsp_recoveries": 1.0})
+                elif joined:
+                    self._bsp_gen += 1
                 gen = self._bsp_gen
             if recovered:
                 _BSP_RECOVERIES.inc()
@@ -427,18 +522,56 @@ class Scheduler:
                 print(f"[recovery] bsp worker-{rank} re-registered at "
                       f"{req['uri']} (was {prev}); generation -> {gen}",
                       flush=True)
+            elif joined:
+                print(f"[membership] bsp worker-{rank} joined at "
+                      f"{req['uri']}; generation -> {gen}", flush=True)
             return {"ok": True, "gen": gen}
         if op == "bsp_peers":
             # BSP workers poll until the full group is up, and re-poll
-            # mid-round to detect membership changes
+            # mid-round to detect membership changes. Once the group
+            # has formed ONCE, the reply reports the CURRENT set even
+            # when it is smaller than the caller's world — that is how
+            # survivors of a leave adopt the shrunk ring instead of
+            # waiting forever for a peer that resigned.
             world = int(req.get("world", self.num_workers))
             with self._lock:
-                ready = len(self._bsp_uris) >= world
+                full = len(self._bsp_uris) >= world > 0
+                if full:
+                    self._bsp_ready = True
+                ready = full or (self._bsp_ready and bool(self._bsp_uris))
                 uris = [self._bsp_uris[r]
                         for r in sorted(self._bsp_uris)] if ready else []
                 gen = self._bsp_gen
             return {"ready": ready, "gen": gen, "uris": uris,
                     "num_known": len(self._bsp_uris)}
+        if op == "bsp_leave":
+            # a BSP worker resigning for good (not a respawn): shrink
+            # the peer set and bump the generation; survivors rebuild
+            # the ring without it.
+            with self._lock:
+                rank = int(req["rank"])
+                uri = req.get("uri")
+                # key by rank when it still maps to this worker's uri;
+                # otherwise fall back to a uri scan — an elastic
+                # survivor may have RE-INDEXED its rank since it
+                # registered (allreduce.py _adopt), so the uri is the
+                # stable identity
+                if uri is None or self._bsp_uris.get(rank) == uri:
+                    left = self._bsp_uris.pop(rank, None) is not None
+                else:
+                    left = False
+                    for r, u in list(self._bsp_uris.items()):
+                        if u == uri:
+                            del self._bsp_uris[r]
+                            rank, left = r, True
+                            break
+                if left:
+                    self._bsp_gen += 1
+                gen = self._bsp_gen
+            if left:
+                print(f"[membership] bsp worker-{rank} left; "
+                      f"generation -> {gen}", flush=True)
+            return {"ok": True, "gen": gen}
         if op == "servers":
             # workers poll until the full `-s` group is up
             with self._lock:
@@ -449,9 +582,18 @@ class Scheduler:
                     "num_known": len(self._server_uris),
                     "num_servers": self.num_servers}
         if op == "get":
+            with self._lock:
+                retire = node in self._retiring
+                mepoch = self._mepoch
+            if retire:
+                # a retiring worker gets no new parts: it drains what it
+                # holds, flushes, and leaves
+                return {"wait": True, "retire": True, "epoch": self._epoch,
+                        "mepoch": mepoch}
             if req.get("epoch") != self._epoch:
                 # worker is in an older round; tell it to resync
-                return {"wait": True, "epoch": self._epoch}
+                return {"wait": True, "epoch": self._epoch,
+                        "mepoch": mepoch}
             with self._lock:
                 if (self._collect is not None
                         and node not in self._collect["reported"]):
@@ -459,16 +601,18 @@ class Scheduler:
                     # the pattern locally and report its files
                     return {"match": self._collect["pattern"],
                             "epoch": self._epoch}
-            got = self.pool.get(node)
+            got = self.pool.get(node, mepoch=mepoch)
             if got is None:
                 done = self._round_finished()
-                return {"done": done, "wait": not done, "epoch": self._epoch}
+                return {"done": done, "wait": not done,
+                        "epoch": self._epoch, "mepoch": mepoch}
             part_id, f = got
             return {
                 "part_id": part_id,
                 "file": dataclasses.asdict(f),
                 "round": self._round,
                 "epoch": self._epoch,
+                "mepoch": mepoch,
             }
         if op == "add_local":
             with self._lock:
@@ -481,8 +625,15 @@ class Scheduler:
                                     node=node)
             return {"ok": True, "num_files": n}
         if op == "finish":
+            # fenced completion: besides the round epoch, the pool
+            # rejects a finish whose sender no longer owns the part — a
+            # node declared dead (assignment reset, membership epoch
+            # bumped) that comes BACK cannot double-apply its stale
+            # assignment; the part's re-execution by a live owner is
+            # what counts
             counted = (req.get("epoch") == self._epoch
-                       and self.pool.finish(req["part_id"]))
+                       and self.pool.finish(req["part_id"], node=node,
+                                            mepoch=req.get("mepoch")))
             # a straggler twin's duplicate finish is dropped so its
             # progress is not double-counted (at-least-once execution,
             # exactly-once accounting); merges run under the lock since
@@ -490,7 +641,7 @@ class Scheduler:
             if counted and req.get("progress"):
                 with self._lock:
                     self.progress.merge(req["progress"])
-            return {"ok": True}
+            return {"ok": True, "counted": counted}
         if op == "report":  # pure progress push (ps::Slave channel)
             with self._lock:
                 self.progress.merge(req.get("progress", {}))
@@ -518,9 +669,13 @@ class Scheduler:
                 self._nodes.pop(node, None)
             return {"ok": True}
         if op == "epoch":
+            with self._lock:
+                retire = node in self._retiring
             return {"epoch": self._epoch,
                     "round": getattr(self, "_round", None),
-                    "shutdown": self._shutdown}
+                    "shutdown": self._shutdown,
+                    "mepoch": self._mepoch,
+                    "retire": retire}
         if op == "barrier":
             return self._barrier_enter(req["name"], node, req["world"])
         if op == "barrier_wait":
@@ -542,6 +697,94 @@ class Scheduler:
                 self._barriers[name] = set()
                 return {"released": True, "gen": gen}
             return {"released": False, "gen": gen}
+
+    # -- elastic membership -------------------------------------------------
+    @property
+    def membership_epoch(self) -> int:
+        return self._mepoch
+
+    def _member_change(self, why: str, node: str) -> None:
+        """The worker set changed (join/leave/eviction): bump the
+        membership epoch and rebalance pinned parts over the live set.
+        Must be called WITHOUT the lock held."""
+        with self._lock:
+            self._mepoch += 1
+            mepoch = self._mepoch
+            live = sorted((n for n in self._nodes
+                           if n.startswith("worker")), key=_worker_rank)
+        _MEPOCHS.inc()
+        repinned = self.pool.repin(live) if live else 0
+        print(f"[membership] epoch -> {mepoch} ({why}: {node}); "
+              f"{len(live)} live workers"
+              + (f", {repinned} parts re-pinned" if repinned else ""),
+              flush=True)
+
+    def set_elastic_target(self, target: int) -> None:
+        """Publish the controller's worker-count goal. Growing is the
+        launcher's half (spawn processes; they `join`); shrinking is
+        decided HERE — the highest-ranked live workers are marked
+        retiring, drain their current part, flush, and `leave`."""
+        with self._lock:
+            self._elastic_target = int(target)
+            live = sorted((n for n in self._nodes
+                           if n.startswith("worker")), key=_worker_rank)
+            active = [n for n in live if n not in self._retiring]
+            excess = len(active) - self._elastic_target
+            newly = []
+            if excess > 0:
+                for n in sorted(active, key=_worker_rank,
+                                reverse=True)[:excess]:
+                    self._retiring.add(n)
+                    newly.append(n)
+        for n in newly:
+            print(f"[membership] retiring {n} (target "
+                  f"{target} < {len(active)} active)", flush=True)
+
+    def start_membership_controller(self, initial_workers: int,
+                                    controller=None) -> None:
+        """WH_ELASTIC decision loop: every WH_ELASTIC_SEC either follow
+        the scripted WH_ELASTIC_PLAN (`join@<sec>,leave@<sec>` offsets
+        from start — deterministic churn for drills) or feed the
+        cluster-aggregated `queue.depth` / `loader.stall_s` gauges to a
+        MembershipController (solver/minibatch_solver.py) and publish
+        its target."""
+        if self._elastic_thread is not None:
+            return
+        cadence = float(knob_value("WH_ELASTIC_SEC"))
+        plan = _parse_elastic_plan(str(knob_value("WH_ELASTIC_PLAN") or ""))
+        if controller is None and not plan:
+            from wormhole_tpu.solver.minibatch_solver import (
+                MembershipController,
+            )
+
+            lo = int(knob_value("WH_ELASTIC_MIN"))
+            hi = int(knob_value("WH_ELASTIC_MAX")) or 2 * initial_workers
+            controller = MembershipController(initial_workers, lo=lo, hi=hi)
+        t0 = time.monotonic()
+
+        def loop():  # wormlint: thread-entry
+            while not self._stop_evt.wait(max(cadence, 0.2)):
+                try:
+                    if plan:
+                        target = initial_workers + sum(
+                            delta for at, delta in plan
+                            if time.monotonic() - t0 >= at)
+                    else:
+                        agg = self.aggregate_metrics()["aggregate"]
+                        gauges = agg.get("gauges", {})
+                        target = controller.record(
+                            float(gauges.get("queue.depth") or 0.0),
+                            float(gauges.get("loader.stall_s") or 0.0),
+                            live=len(self.live_workers()))
+                    if target is not None:
+                        self.set_elastic_target(target)
+                except Exception:
+                    pass  # a malformed snapshot must not kill the loop
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._elastic_thread = t
+        self._threads.append(t)
 
     # -- telemetry ----------------------------------------------------------
     def _scrape_loop(self) -> None:  # wormlint: thread-entry
@@ -659,6 +902,15 @@ class Scheduler:
                 if skipped:
                     print(f"node {n} lost; {skipped} parts only it could "
                           "read are skipped", flush=True)
+                if n.startswith("worker"):
+                    # a declared-dead worker is a membership change: the
+                    # epoch bump (plus the assignment reset above, which
+                    # clears the parts' owner/epoch stamps) fences any
+                    # late completion the node sends if it comes back
+                    with self._lock:
+                        self._members.discard(n)
+                        self._retiring.discard(n)
+                    self._member_change("evict", n)
                 with self._lock:
                     if (self._collect is not None
                             and n not in self._collect["reported"]):
@@ -726,22 +978,29 @@ class SchedulerClient:
                   data=base64.b64encode(buf.getvalue()).decode())
 
     def blob_get(self, key: str, timeout: float = 60.0, poll: float = 0.1):
+        """Fetch a rendezvous payload, waiting for the publisher under
+        the unified retry policy: jittered backoff growing from `poll`
+        instead of a fixed-interval busy-poll (which spun the scheduler
+        whenever a partition fault delayed the publisher), bounded by
+        the caller's `timeout`."""
         import base64
         import io
 
         import numpy as np
 
-        deadline = time.monotonic() + timeout
+        budget = _retry.RetryBudget(timeout, base_s=poll, op="blob_get")
         while True:
             r = self.call(op="blob_get", key=key)
             if r.get("ok"):
+                budget.succeeded()
                 got = np.load(io.BytesIO(base64.b64decode(r["data"])))
                 if hasattr(got, "files"):  # npz: dict payload
                     return {k: got[k] for k in got.files}
                 return got
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"blob {key!r} never published")
-            time.sleep(poll)
+            if budget.expired:
+                budget.give_up(
+                    TimeoutError(f"blob {key!r} never published"))
+            budget.sleep()
 
     def report(self, progress: dict) -> None:
         self.call(op="report", progress=progress)
@@ -812,14 +1071,30 @@ class RemotePool:
         self.poll = poll
         self.epoch = 0  # joins whatever round is live on first sync_round
         self.round: Optional[dict] = None
+        # elastic membership state observed on replies: the membership
+        # epoch (the worker's store absorbs bumps between parts) and
+        # the retire flag (the scheduler asked this worker to drain,
+        # flush, and leave)
+        self.mepoch = 0
+        self.retire = False
+        self._part_mepoch: dict[int, int] = {}
+
+    def _observe(self, r: dict) -> None:
+        if "mepoch" in r:
+            self.mepoch = r["mepoch"]
+        if r.get("retire"):
+            self.retire = True
 
     def sync_round(self, wait: bool = True) -> Optional[dict]:
         """Adopt the scheduler's next dispatch round (type/data_pass).
-        Returns None on job shutdown. Blocks until the epoch advances past
-        the one this pool last worked."""
+        Returns None on job shutdown (or once this worker is marked
+        retiring — the caller leaves instead of joining a new round).
+        Blocks until the epoch advances past the one this pool last
+        worked."""
         while True:
             r = self.client.call(op="epoch")
-            if r.get("shutdown"):
+            self._observe(r)
+            if r.get("shutdown") or self.retire:
                 return None
             if r.get("round") is not None and r["epoch"] > self.epoch:
                 self.epoch = r["epoch"]
@@ -832,7 +1107,16 @@ class RemotePool:
     def get(self, node: str = "") -> Optional[tuple[int, File]]:
         while True:
             r = self.client.call(op="get", epoch=self.epoch)
+            self._observe(r)
+            if self.retire:
+                # drain stops here; the part we were handed (if any)
+                # was not: retire replies never carry part_ids
+                return None
             if "part_id" in r:
+                # remember the membership epoch the assignment was made
+                # under; finish() echoes it so the scheduler can fence
+                # completions that straddled a membership change
+                self._part_mepoch[r["part_id"]] = r.get("mepoch", 0)
                 f = File(**r["file"])
                 return r["part_id"], f
             if "match" in r:
@@ -860,4 +1144,23 @@ class RemotePool:
 
     def finish(self, part_id: int, progress: Optional[dict] = None) -> None:
         self.client.call(op="finish", part_id=part_id, epoch=self.epoch,
+                         mepoch=self._part_mepoch.pop(part_id, None),
                          progress=progress or {})
+
+    def join(self) -> dict:
+        """Announce this worker as an elastic joiner of a running job
+        (bumps the membership epoch scheduler-side) and adopt the
+        current state."""
+        r = self.client.call(op="join")
+        self._observe(r)
+        return r
+
+    def leave(self) -> None:
+        """Resign from the job cleanly (retirement, or degradation out
+        of a partition): the scheduler drops us from liveness NOW and
+        re-queues anything we still held."""
+        try:
+            self.client.call(op="leave",
+                             metrics=_obs.REGISTRY.snapshot())
+        except Exception:
+            pass  # leaving best-effort: liveness eviction is the backstop

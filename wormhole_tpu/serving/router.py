@@ -36,6 +36,7 @@ import numpy as np
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
+from wormhole_tpu.runtime import retry as _retrylib
 from wormhole_tpu.runtime.net import (
     busy_backoff, connect_with_retry, recv_frame, send_frame,
 )
@@ -177,7 +178,8 @@ class Router:
         try:
             hdr = dict(header, sender=slot.sender, seq=slot.seq)
             slot.seq += 1
-            deadline = time.monotonic() + max(self.retry_deadline, 0.0)
+            budget = _retrylib.RetryBudget(max(self.retry_deadline, 0.0),
+                                           base_s=0.1, op="serve.rpc")
             while True:
                 try:
                     if slot.f is None:
@@ -198,16 +200,17 @@ class Router:
                     if "error" in reply:
                         raise RuntimeError(
                             f"serve shard {r}: {reply['error']}")
+                    budget.succeeded()
                     return reply, rarr
-                except (OSError, ConnectionError):
+                except (OSError, ConnectionError) as e:
                     slot.close()
-                    if time.monotonic() >= deadline:
-                        raise
+                    if budget.expired:
+                        budget.give_up(e)
                     _ROUTER_RETRIES.inc()
                     # a respawned shard re-registered under a new uri;
                     # the resolver hands it to the next dial
                     self._refresh_uris()
-                    time.sleep(0.1)
+                    budget.sleep()
         finally:
             self._release(r, slot)
 

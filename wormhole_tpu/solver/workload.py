@@ -140,7 +140,7 @@ class WorkloadPool:
                         dict(file=File(f, fmt, k, num_parts_per_file),
                              state=0, node=None, t_start=0.0,
                              affinity=({node} if node else set()),
-                             pin=None)
+                             pin=None, mepoch=None)
                     )
             if shuffle:
                 random.Random(seed).shuffle(self._parts)
@@ -163,10 +163,13 @@ class WorkloadPool:
             self.num_finished = 0
 
     # -- dispatch -----------------------------------------------------------
-    def get(self, node: str) -> Optional[tuple[int, File]]:
+    def get(self, node: str,
+            mepoch: Optional[int] = None) -> Optional[tuple[int, File]]:
         """Assign one available part to `node`; None when nothing avail.
         Parts with a non-empty capable set only go to nodes in it
-        (workload_pool.h:141,155)."""
+        (workload_pool.h:141,155). `mepoch` stamps the assignment with
+        the membership epoch it was made under — the fence finish()
+        checks."""
         with self._lock:
             avail = [i for i, p in enumerate(self._parts)
                      if p["state"] == 0
@@ -176,30 +179,73 @@ class WorkloadPool:
                 return None
             i = random.choice(avail)
             p = self._parts[i]
-            p.update(state=1, node=node, t_start=time.monotonic())
+            p.update(state=1, node=node, t_start=time.monotonic(),
+                     mepoch=mepoch)
             return i, p["file"]
 
-    def finish(self, part_id: int) -> bool:
+    def finish(self, part_id: int, node: Optional[str] = None,
+               mepoch: Optional[int] = None) -> bool:
         """Mark done; False if a straggler twin already finished it (the
-        caller must not double-count its progress)."""
+        caller must not double-count its progress).
+
+        With `node`, the completion is FENCED: it only counts if the
+        part still belongs to this node — or was merely re-queued by
+        the straggler watchdog (owner cleared but the membership stamp
+        intact, in which case the original owner's late finish is the
+        work arriving). A node declared DEAD had its parts reset with
+        the stamp cleared, so its late completions are rejected even
+        though the part sits unassigned — the double-apply hole the
+        membership epoch closes. Callers without node/mepoch keep the
+        legacy accept-any semantics (in-process pools)."""
         with self._lock:
             p = self._parts[part_id]
             if p["state"] == 2:
                 return False
+            if node is not None:
+                owned = p["node"] == node
+                requeued_twin = (p["node"] is None
+                                 and p["mepoch"] is not None
+                                 and p["mepoch"] == mepoch)
+                if not (owned or requeued_twin):
+                    return False
             p["state"] = 2
             self._durations.append(time.monotonic() - p["t_start"])
             self.num_finished += 1
             return True
 
     def reset(self, node: str) -> int:
-        """Re-queue parts assigned to a dead node; returns count."""
+        """Re-queue parts assigned to a dead node; returns count. The
+        membership stamp is cleared: a reset part's original assignment
+        is fenced for good (unlike a straggler re-queue, which keeps
+        the stamp so the slow owner's work can still land)."""
         n = 0
         with self._lock:
             for p in self._parts:
                 if p["state"] == 1 and p["node"] == node:
-                    p.update(state=0, node=None)
+                    p.update(state=0, node=None, mepoch=None)
                     n += 1
         return n
+
+    def repin(self, nodes: list) -> int:
+        """Membership changed: re-pin batch-mode pinned parts round-robin
+        over the surviving/new node set. Idempotent — pin follows part
+        order, so a repeat call with the same set changes nothing.
+        Online-mode pools (no pins) are untouched. Returns the number of
+        pins that moved."""
+        if not nodes:
+            return 0
+        moved = 0
+        with self._lock:
+            k = 0
+            for p in self._parts:
+                if p["pin"] is None:
+                    continue
+                want = nodes[k % len(nodes)]
+                k += 1
+                if p["pin"] != want:
+                    p["pin"] = want
+                    moved += 1
+        return moved
 
     def drop_node(self, node: str) -> tuple[int, int]:
         """A node left for good: release its batch-mode pins (anyone can
