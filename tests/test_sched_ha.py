@@ -1,0 +1,422 @@
+"""Control-plane durability (runtime/sched_journal.py + the scheduler's
+journal/replay/fence layer in runtime/tracker.py): journal replay
+round-trips the scheduler's state, a torn tail truncates cleanly,
+compaction preserves the restored state, incarnation fencing rejects
+pre-restart ghosts, and the reply cache keeps retried mutating RPCs
+exactly-once across a restart. The slow test drives the real launcher
+with WH_FAULT_SPEC=sched:kill@... and --max-scheduler-restarts."""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime.sched_journal import SchedulerJournal
+from wormhole_tpu.runtime.tracker import (
+    RemotePool, Scheduler, SchedulerClient,
+)
+from wormhole_tpu.solver.workload import WorkType
+
+from conftest import synth_libsvm_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_parts(tmp_path, n=4):
+    d = tmp_path / "data"
+    d.mkdir(exist_ok=True)
+    for i in range(n):
+        (d / f"part-{i}").write_text("")
+    return str(d)
+
+
+def _counter(name: str) -> int:
+    return int(_obs.REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+# ------------------------------------------------------------- journal
+def test_journal_replay_round_trip(tmp_path):
+    """Register + round + get + finish + blob, kill-free restart: the
+    second incarnation must restore epoch, pool part states, merged
+    progress, blobs, and the per-sender reply cache from the journal."""
+    data = make_parts(tmp_path)
+    jdir = str(tmp_path / "ctl")
+    s1 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s1.serve()
+    try:
+        assert s1.incarnation == 0
+        c = SchedulerClient(s1.uri, "w0")
+        c.register()
+        n = s1.start_round(f"{data}/part-.*", 2, "libsvm",
+                           WorkType.TRAIN, 0)
+        assert n == 4
+        pool = RemotePool(c, poll=0.02)
+        pool.sync_round()
+        part_id, _f = pool.get()
+        pool.finish(part_id, {"nex": 3.0})
+        s1.publish_blob("resume-key", "resume-val")
+        epoch1 = s1._epoch
+        finished1 = s1.pool.export_state()["num_finished"]
+        assert finished1 == 1
+    finally:
+        s1.stop()
+
+    s2 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s2.serve()
+    try:
+        assert s2.incarnation == 1
+        assert s2._epoch == epoch1
+        assert s2._round is not None
+        assert int(s2._round["type"]) == int(WorkType.TRAIN)
+        st = s2.pool.export_state()
+        assert st["num_finished"] == 1
+        assert not s2.pool.is_finished()
+        assert s2.progress.value("nex") == 3.0
+        assert s2.has_blob("resume-key")
+        # the reply cache came back: the finish (the client's last
+        # mutating RPC) is cached under its sender key
+        assert c._sender in s2._replies
+    finally:
+        s2.stop()
+
+    # a THIRD start (no new ops in between) keeps counting incarnations
+
+    s3 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s3.serve()
+    try:
+        assert s3.incarnation == 2
+        assert s3.progress.value("nex") == 3.0
+    finally:
+        s3.stop()
+
+
+def test_journal_torn_tail_truncates(tmp_path):
+    """A crash mid-append leaves an unterminated last line: load() must
+    return every complete record, drop the torn tail, and truncate the
+    file in place so the next append starts from a clean prefix."""
+    jdir = str(tmp_path / "ctl")
+    j = SchedulerJournal(jdir)
+    for i in range(3):
+        j.record({"k": "blob", "key": f"k{i}", "data": "x"})
+    j.close()
+    path = os.path.join(jdir, "sched.journal")
+    with open(path, "ab") as fh:
+        fh.write(b'{"k": "blob", "key": "torn-no-newline"')
+    snap, recs, max_inc = SchedulerJournal(jdir).load()
+    assert snap is None
+    assert [r["key"] for r in recs] == ["k0", "k1", "k2"]
+    assert max_inc == -1
+    with open(path, "rb") as fh:
+        body = fh.read()
+    assert body.endswith(b"\n") and body.count(b"\n") == 3
+
+    # corrupt json mid-file fences everything after it too (suffix
+    # ordering can no longer be trusted)
+    with open(path, "ab") as fh:
+        fh.write(b"not json at all\n")
+        fh.write(b'{"k": "blob", "key": "after-corruption", "data": "x"}\n')
+    _snap, recs, _ = SchedulerJournal(jdir).load()
+    assert [r["key"] for r in recs] == ["k0", "k1", "k2"]
+
+
+def test_compaction_preserves_restored_state(tmp_path):
+    """With the compaction threshold forced to 1 every round boundary
+    folds the journal into the snapshot; the restart must restore the
+    same epoch/progress/pool state the tail-replay path would."""
+    data = make_parts(tmp_path)
+    jdir = str(tmp_path / "ctl")
+    s1 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s1._compact_every = 1  # force a compaction at each round start
+    s1.serve()
+    compactions0 = _counter("sched.journal.compactions")
+    try:
+        c = SchedulerClient(s1.uri, "w0")
+        c.register()
+        for dp in range(2):
+            s1.start_round(f"{data}/part-.*", 1, "libsvm",
+                           WorkType.TRAIN, dp)
+            pool = RemotePool(c, poll=0.02)
+            pool.sync_round()
+            while (got := pool.get()) is not None:
+                pid, _f = got
+                pool.finish(pid, {"nex": 1.0})
+            s1.wait_round(print_sec=0.05, verbose=False)
+        epoch1 = s1._epoch
+    finally:
+        s1.stop()
+    assert _counter("sched.journal.compactions") > compactions0
+    assert os.path.exists(os.path.join(jdir, "sched.snapshot"))
+
+    s2 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s2.serve()
+    try:
+        assert s2.incarnation == 1
+        assert s2._epoch == epoch1
+        assert s2.pool.is_finished()
+        # the last round's 4 parts all finished and their progress
+        # survived snapshot + tail replay
+        assert s2.progress.value("nex") == 4.0
+        assert s2.pool.export_state()["num_finished"] == 4
+    finally:
+        s2.stop()
+
+
+# ------------------------------------------------- exactly-once + fence
+def test_dedup_and_stale_seq_fence(tmp_path):
+    """A retried mutating RPC (same seq) must come back from the reply
+    cache without re-executing; an OLDER seq is a pre-restart ghost and
+    must be fenced with an error."""
+    data = make_parts(tmp_path)
+    sched = Scheduler(node_timeout=10, straggler=False)
+    sched.serve()
+    try:
+        c = SchedulerClient(sched.uri, "w0")
+        c.register()
+        sched.start_round(f"{data}/part-.*", 1, "libsvm",
+                          WorkType.TRAIN, 0)
+        pool = RemotePool(c, poll=0.02)
+        pool.sync_round()
+        part_id, _f = pool.get()
+        pool.finish(part_id, {"nex": 5.0})
+        assert sched.progress.value("nex") == 5.0
+        hits0 = _counter("sched.rpc.dedup_hits")
+        # re-mint the SAME seq: the resend must dedup, not double-merge
+        with c._seq_lock:
+            c._seq -= 1
+        r = c.call(op="finish", part_id=part_id, epoch=pool.epoch,
+                   progress={"nex": 5.0})
+        assert r["inc"] == 0
+        assert sched.progress.value("nex") == 5.0
+        assert _counter("sched.rpc.dedup_hits") == hits0 + 1
+        # an older-than-cached seq is fenced, not executed
+        with c._seq_lock:
+            c._seq -= 2
+        with pytest.raises(RuntimeError, match="stale scheduler seq"):
+            c.call(op="report", progress={"nex": 99.0})
+        assert sched.progress.value("nex") == 5.0
+    finally:
+        sched.stop()
+
+
+def test_reply_cache_exactly_once_across_restart(tmp_path):
+    """The poison case the journal exists for: a finish whose reply was
+    lost in the crash. The respawned scheduler must answer the retry
+    from the JOURNALED reply cache — stamped with the new incarnation —
+    instead of merging the progress twice."""
+    data = make_parts(tmp_path)
+    jdir = str(tmp_path / "ctl")
+    s1 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s1.serve()
+    try:
+        c = SchedulerClient(s1.uri, "w0")
+        c.register()
+        s1.start_round(f"{data}/part-.*", 2, "libsvm", WorkType.TRAIN, 0)
+        pool = RemotePool(c, poll=0.02)
+        pool.sync_round()
+        part_id, _f = pool.get()
+        pool.finish(part_id, {"nex": 7.0})
+        round_epoch = pool.epoch
+    finally:
+        s1.stop()
+
+    s2 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s2.serve()
+    try:
+        assert s2.incarnation == 1
+        assert s2.progress.value("nex") == 7.0
+        hits0 = _counter("sched.rpc.dedup_hits")
+        c2 = SchedulerClient(s2.uri, "w0")
+        c2._sender = c._sender  # the SAME logical sender retries
+        with c2._seq_lock:
+            c2._seq = c._seq - 1  # retry mints the crashed call's seq
+        r = c2.call(op="finish", part_id=part_id, epoch=round_epoch,
+                    progress={"nex": 7.0})
+        assert r["inc"] == 1  # cached reply restamped with the new inc
+        assert s2.progress.value("nex") == 7.0  # merged exactly once
+        assert _counter("sched.rpc.dedup_hits") == hits0 + 1
+        assert s2.pool.export_state()["num_finished"] == 1
+    finally:
+        s2.stop()
+
+
+# ------------------------------------------------------------- faults
+def test_sched_kill_spec_arming():
+    """sched:kill@<op>:<nth>[:always] parses, counts per-op, respects
+    role/epoch arming, and leaves the legacy sched:drop grammar
+    untouched."""
+    killed = []
+    f = faults.Faults("sched:kill@finish:2", role="scheduler")
+    f.kill_fn = killed.append
+    f.sched_op("get")
+    f.sched_op("finish")
+    assert killed == []
+    f.sched_op("finish")
+    assert killed == [faults.KILL_EXIT]
+
+    # off-role: a worker process must never arm a scheduler kill
+    g = faults.Faults("sched:kill@finish:1", role="worker")
+    g.kill_fn = killed.append
+    g.sched_op("finish")
+    assert killed == [faults.KILL_EXIT]
+
+    # a RESPAWNED scheduler (restore epoch > 0) does not re-arm ...
+    h = faults.Faults("sched:kill@finish:1", role="scheduler", epoch=1)
+    h.kill_fn = killed.append
+    h.sched_op("finish")
+    assert killed == [faults.KILL_EXIT]
+    # ... unless :always asks for a kill in every incarnation
+    k = faults.Faults("sched:kill@finish:1:always", role="scheduler",
+                      epoch=1)
+    k.kill_fn = killed.append
+    k.sched_op("finish")
+    assert killed == [faults.KILL_EXIT, faults.KILL_EXIT]
+
+    # "any" counts across ops
+    killed.clear()
+    a = faults.Faults("sched:kill@any:3", role="scheduler")
+    a.kill_fn = killed.append
+    a.sched_op("get")
+    a.sched_op("finish")
+    assert killed == []
+    a.sched_op("report")
+    assert killed == [faults.KILL_EXIT]
+
+    # legacy drop grammar still raises ConnectionError at the nth op
+    d = faults.Faults("sched:drop@register_server:1", role="scheduler")
+    with pytest.raises(ConnectionError):
+        d.sched_op("register_server")
+
+
+def test_client_retry_rides_out_scheduler_outage(tmp_path):
+    """A SchedulerClient with a retry deadline keeps retrying through a
+    dead-scheduler window and lands on the rebound replacement."""
+    import threading
+
+    jdir = str(tmp_path / "ctl")
+    s1 = Scheduler(node_timeout=10, straggler=False, journal_dir=jdir)
+    s1.serve()
+    host, port = s1.uri.split(":")
+    c = SchedulerClient(s1.uri, "w0", timeout=5.0, connect_deadline=2.0,
+                        retry_deadline=30.0)
+    c.register()
+    s1.stop()
+
+    def rebind():
+        time.sleep(1.0)
+        s2 = Scheduler(host, int(port), node_timeout=10, straggler=False,
+                       journal_dir=jdir)
+        s2.serve()
+        rebind.sched = s2
+
+    t = threading.Thread(target=rebind)
+    t.start()
+    try:
+        # issued while the port is dark; must ride the budget out and
+        # execute on the new incarnation
+        r = c.call(op="blob_put", key="after", data="restart")
+        assert r["inc"] == 1
+        assert c._inc == 1
+    finally:
+        t.join()
+        rebind.sched.stop()
+
+
+# ------------------------------------------------------- launcher drill
+@pytest.mark.slow
+def test_launcher_scheduler_respawn_drill(tmp_path):
+    """End-to-end: a 2-worker/1-server difacto job whose scheduler
+    kills itself at finish #4; --max-scheduler-restarts 1 must respawn
+    it on the pinned URI, replay the journal, and converge with zero
+    retry give-ups."""
+    for i in range(2):
+        (tmp_path / f"train-{i}.libsvm").write_text(
+            synth_libsvm_text(n_rows=256, seed=i))
+    (tmp_path / "val.libsvm").write_text(
+        synth_libsvm_text(n_rows=256, seed=9))
+    conf = tmp_path / "job.conf"
+    conf.write_text(f"""
+train_data = "{tmp_path}/train-.*"
+val_data = "{tmp_path}/val.libsvm"
+algo = ftrl
+dim = 4
+threshold = 2
+lambda_l1 = 0.5
+minibatch = 128
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = 3
+max_delay = 1
+""")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               WH_FAULT_SPEC="sched:kill@finish:4")
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+         "-n", "2", "-s", "1", "--node-timeout", "10",
+         "--max-scheduler-restarts", "1", "--",
+         sys.executable, "-m", "wormhole_tpu.apps.difacto", str(conf)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-4000:]
+    assert "[faults] scheduler killing itself" in out, out[-4000:]
+    assert re.search(r"scheduler died \(exit -?\d+\); respawning", out), \
+        out[-4000:]
+    assert "[recovery] scheduler resumed at incarnation 1" in out, \
+        out[-4000:]
+    assert re.search(r"final val: logloss=[0-9.]+", out), out[-4000:]
+    m = re.search(r"give_ups=(\d+)", out)
+    assert m and m.group(1) == "0", out[-4000:]
+
+
+@pytest.mark.slow
+def test_launcher_scheduler_kill_bsp_bit_identical(tmp_path):
+    """The strict variant on the BSP plane: a 3-process GBDT job whose
+    SCHEDULER is killed mid-epoch (the collectives are worker-to-worker,
+    so nothing may perturb the math) must produce a model bit-identical
+    to the fault-free run's after the respawn + journal replay."""
+    import numpy as np
+
+    for i in range(3):
+        (tmp_path / f"train-{i}.libsvm").write_text(
+            synth_libsvm_text(n_rows=150, n_feat=300, seed=i))
+    (tmp_path / "val.libsvm").write_text(
+        synth_libsvm_text(n_rows=100, n_feat=300, seed=9))
+
+    def run(tag, fault):
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env.pop("WH_OBS_DIR", None)
+        if fault:
+            env["WH_FAULT_SPEC"] = fault
+        else:
+            env.pop("WH_FAULT_SPEC", None)
+        model = tmp_path / f"model-{tag}.npz"
+        r = subprocess.run(
+            [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+             "-n", "3", "-s", "0", "--node-timeout", "10",
+             "--max-scheduler-restarts", "1", "--",
+             sys.executable, "-m", "wormhole_tpu.apps.gbdt",
+             f"train_data={tmp_path}/train-.*",
+             f"eval_data={tmp_path}/val.libsvm",
+             "bsp=1", "num_round=3", "max_depth=2", "max_bin=16",
+             "minibatch=128", f"model_out={model}"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO)
+        assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+        return model, r.stdout
+
+    base_model, _ = run("base", None)
+    # liveness pings (op `epoch`, 3 workers x 2s cadence) are the BSP
+    # plane's steady scheduler traffic: this ~12s job sees ~9 of them,
+    # so #5 lands mid-round with rounds still to go
+    kill_model, out = run("kill", "sched:kill@epoch:5")
+    assert "[faults] scheduler killing itself" in out, out[-4000:]
+    assert "[recovery] scheduler resumed at incarnation 1" in out, \
+        out[-4000:]
+    a, b = np.load(base_model), np.load(kill_model)
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        assert np.array_equal(a[k], b[k]), f"array {k!r} diverged"

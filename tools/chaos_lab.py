@@ -69,6 +69,21 @@ A third lab rides the same harness:
                1` tier plus an in-process router driver that must see
                ZERO failed predict requests throughout.
 
+A fourth lab targets the control plane itself:
+
+  --sched      the scheduler-kill drill (docs/distributed.md,
+               "control-plane fault tolerance"): WH_FAULT_SPEC
+               `sched:kill@<op>:<nth>` makes the scheduler kill ITSELF
+               mid-RPC; the launcher (--max-scheduler-restarts)
+               respawns it on the same pinned URI and the replacement
+               replays its write-ahead journal. Verdicts demand
+               convergence parity on the PS plane (plus zero failed
+               predicts under a --serve load) and a BIT-IDENTICAL
+               model on the BSP plane, with sched_recoveries >= 1,
+               journal replays > 0, and retry_give_ups == 0 in every
+               run report. With --no-recovery the kill must instead
+               fail the job fast.
+
 Usage:
   JAX_PLATFORMS=cpu python tools/chaos_lab.py
   python tools/chaos_lab.py --specs "server:0:kill@push:30" --restarts 2
@@ -281,7 +296,8 @@ def fault_fired(out: str) -> bool:
     lines of every faults.py family: net injections, server kills, and
     BSP worker kills."""
     return bool(re.search(
-        r"\[faults\] (injecting|server rank|worker rank)", out))
+        r"\[faults\] (injecting|server rank|worker rank|"
+        r"scheduler killing)", out))
 
 
 def models_equal(a_path: str, b_path: str) -> tuple[bool, str]:
@@ -303,10 +319,12 @@ def models_equal(a_path: str, b_path: str) -> tuple[bool, str]:
 
 def run_bsp_job(module: str, app_args: list[str], spec: str,
                 workers: int, restarts: int, timeout: float,
-                obs_dir: str) -> tuple[int, str, float, dict | None]:
+                obs_dir: str, launcher_args: list[str] | None = None
+                ) -> tuple[int, str, float, dict | None]:
     """One launcher run of a BSP app: `-s 0` (no ps plane) with worker
     supervision on — the respawned incarnation resumes from its BSP
-    version checkpoint."""
+    version checkpoint. `launcher_args` rides extra launcher flags (the
+    --sched drill adds --max-scheduler-restarts here)."""
     env = dict(os.environ, PYTHONPATH=REPO)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("WH_FAULT_SPEC", None)
@@ -320,7 +338,8 @@ def run_bsp_job(module: str, app_args: list[str], spec: str,
         [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
          "-n", str(workers), "-s", "0",
          "--node-timeout", "10",
-         "--max-worker-restarts", str(restarts), "--",
+         "--max-worker-restarts", str(restarts)]
+        + list(launcher_args or []) + ["--",
          sys.executable, "-m", module] + app_args,
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
     report = None
@@ -443,11 +462,14 @@ def _predict_block(rng, rows: int, nnz: int):
     )
 
 
-def _serve_driver(sched_uri: str, stop, stats: dict) -> None:
+def _serve_driver(sched_uri: str, stop, stats: dict,
+                  retry_deadline: float | None = None) -> None:
     """Closed-loop predict load against the job's --serve tier for the
     whole churn window. The acceptance bar is ZERO failed requests:
     worker joins/leaves, snapshot swaps, and part re-pins must never be
-    visible to the serving path."""
+    visible to the serving path. `retry_deadline` budgets the driver's
+    scheduler RPCs so shard re-resolution rides out a scheduler restart
+    (the --sched drill sets it; the default keeps fail-fast)."""
     from wormhole_tpu.models.difacto import DifactoConfig
     from wormhole_tpu.runtime.tracker import SchedulerClient
     from wormhole_tpu.serving import DifactoScorer, Router
@@ -458,7 +480,8 @@ def _serve_driver(sched_uri: str, stop, stats: dict) -> None:
     blocks = [_predict_block(rng, 64, 8) for _ in range(4)]
     try:
         router = Router.from_scheduler(
-            SchedulerClient(sched_uri, "chaos-serve-driver"),
+            SchedulerClient(sched_uri, "chaos-serve-driver",
+                            retry_deadline=retry_deadline),
             DifactoScorer(cfg), world=1, timeout=90.0)
     except Exception as e:  # the verdict reports it; don't kill the lab
         stats["error"] = f"router never came up: {e}"
@@ -665,6 +688,289 @@ max_delay = 1
     return worst if worst != 1 else 1
 
 
+# --sched matrix: (name, fault spec, serve drill). The specs kill the
+# SCHEDULER itself mid-RPC (runtime/faults.py sched:kill@<op>:<nth>):
+# with 2 workers finishing ~3 parts per pass, finish #5/#7 land inside
+# pass 1-2 of the 4-pass job with real work on both sides of the
+# restart. The launcher respawns the scheduler on the same pinned URI
+# and the replacement resumes from its journal (runtime/sched_journal).
+SCHED_SCENARIOS = [
+    ("kill-mid-pass", "sched:kill@finish:5", False),
+    ("kill+serve", "sched:kill@finish:7", True),
+]
+
+#: BSP-plane scheduler kill: BSP workers only touch the scheduler for
+#: rendezvous and liveness, so the ping op (`epoch`, one per worker per
+#: 2s) is the only reliably mid-run scheduler traffic — #12 lands ~8s
+#: into the gbdt job, mid-round with checkpoints already written
+SCHED_BSP_SPEC = "sched:kill@epoch:12"
+
+_SCHED_METRIC_KEYS = ("sched_recoveries", "sched_incarnation",
+                      "sched_journal_appends", "sched_journal_replays",
+                      "sched_journal_compactions", "sched_rpc_dedup_hits",
+                      "retry_attempts", "retry_give_ups", "ps_retries")
+
+
+def run_sched_job(conf: str, spec: str, workers: int, servers: int,
+                  restarts: int, timeout: float, obs_dir: str,
+                  serve: bool = False
+                  ) -> tuple[int, str, float, dict | None, dict]:
+    """One launcher run with scheduler supervision on
+    (--max-scheduler-restarts): the injected sched:kill must be ridden
+    out by a respawn + journal replay. With serve=True the scheduler
+    port is pinned and a router driver fires predict batches throughout
+    — including across the restart window."""
+    import threading
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    for k in ("WH_FAULT_SPEC", "WH_OBS_DIR", "WH_SCHED_PORT"):
+        env.pop(k, None)
+    env["WH_ASYNC_SYNC"] = "1"
+    env["WH_KEYCACHE"] = "1"
+    if spec:
+        env["WH_FAULT_SPEC"] = spec
+    os.makedirs(obs_dir, exist_ok=True)
+    env["WH_OBS_DIR"] = obs_dir
+    argv = [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
+            "-n", str(workers), "-s", str(servers),
+            "--node-timeout", "10",
+            "--max-scheduler-restarts", str(restarts)]
+    stats = {"requests": 0, "failures": 0}
+    port = None
+    if serve:
+        port = _free_port()
+        env["WH_SCHED_PORT"] = str(port)
+        argv += ["--serve", "1"]
+    argv += ["--", sys.executable, "-m", "wormhole_tpu.apps.difacto",
+             conf]
+    t0 = time.monotonic()
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=env, cwd=REPO)
+    stop = threading.Event()
+    driver = None
+    if serve:
+        # the driver's own scheduler RPCs must ride out the restart too
+        # (shard re-resolution hits the respawned scheduler), so it gets
+        # an explicit budget instead of the fail-fast default
+        driver = threading.Thread(
+            target=_serve_driver,
+            args=(f"127.0.0.1:{port}", stop, stats, 60.0),
+            daemon=True)
+        driver.start()
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    finally:
+        stop.set()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    if driver is not None:
+        driver.join(timeout=30)
+    report = None
+    try:
+        with open(os.path.join(obs_dir, "run_report.json")) as fh:
+            report = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass  # a crashed run may not get as far as the report
+    return proc.returncode, out, time.monotonic() - t0, report, stats
+
+
+def sched_respawns(out: str) -> int:
+    return len(re.findall(
+        r"scheduler died \(exit -?\d+\); respawning", out))
+
+
+def sched_matrix(args) -> int:
+    """The --sched lab (control-plane fault tolerance): kill the
+    scheduler itself mid-job on both planes and demand full recovery.
+
+    PS plane: a difacto job under SCHED_SCENARIOS — each run must (a)
+    exit clean and converge within --tol of the unfaulted baseline, (b)
+    actually fire the kill and respawn (sched_recoveries >= 1, journal
+    appends + replays > 0), (c) end with retry_give_ups == 0 (every
+    client rode the outage out on its budget), and (d) under --serve
+    load, drop ZERO predict requests across the restart window.
+
+    BSP plane: the gbdt job with the scheduler killed mid-round — the
+    collectives are worker-to-worker, so the model must come out
+    BIT-IDENTICAL to the fault-free baseline while the respawned
+    scheduler still aggregates the final run report."""
+    workers = args.workers or 2
+    restarts = 0 if args.no_recovery else args.restarts
+    scratch = tempfile.mkdtemp(prefix="wh_chaos_sched_")
+    for i in range(2):
+        synth_libsvm(os.path.join(scratch, f"train-{i}.libsvm"),
+                     args.rows, seed=i)
+    synth_libsvm(os.path.join(scratch, "val.libsvm"), args.rows, seed=9)
+    conf = os.path.join(scratch, "chaos.conf")
+    with open(conf, "w") as fh:
+        fh.write(f"""
+train_data = "{scratch}/train-.*"
+val_data = "{scratch}/val.libsvm"
+algo = ftrl
+dim = 4
+threshold = 2
+lambda_l1 = 0.5
+minibatch = 128
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = {args.passes}
+max_delay = 1
+""")
+    print(f"[chaos] stack=sched scratch={scratch} workers={workers} "
+          f"servers={args.servers} max_scheduler_restarts={restarts}")
+
+    rc, out, dt, base_report, _ = run_sched_job(
+        conf, "", workers, args.servers, restarts, args.timeout,
+        os.path.join(scratch, "obs-baseline"))
+    base = final_logloss(out)
+    if rc != 0 or base is None:
+        print(out[-4000:])
+        print(f"[chaos] baseline (no fault) FAILED rc={rc} — nothing to "
+              "compare against; fix the clean path first")
+        return 2
+    base_m = report_metrics(base_report, _SCHED_METRIC_KEYS)
+    print(f"[chaos] baseline: logloss={base:.5f} ({dt:.0f}s) "
+          f"journal_appends={base_m['sched_journal_appends']}")
+
+    rows, worst = [], 0
+    for i, (name, spec, serve) in enumerate(SCHED_SCENARIOS):
+        rc, out, dt, report, stats = run_sched_job(
+            conf, spec, workers, args.servers, restarts, args.timeout,
+            os.path.join(scratch, f"obs-{i}"), serve=serve)
+        ll = final_logloss(out)
+        m = report_metrics(report, _SCHED_METRIC_KEYS)
+        if args.no_recovery:
+            # fail-fast contract: with supervision off, a scheduler kill
+            # must take the job down, not limp to a "pass"
+            if rc != 0:
+                verdict, detail = "survived", f"failed fast (rc={rc})"
+            else:
+                verdict, detail = ("SILENT-CORRUPTION",
+                                   "job passed with recovery OFF")
+                worst = max(worst, 3)
+        elif rc != 0 or ll is None:
+            verdict, detail = "FAILED", f"rc={rc} logloss={ll}"
+            worst = max(worst, 1)
+            tail = "\n".join(out.splitlines()[-12:])
+            detail += "\n    " + tail.replace("\n", "\n    ")
+        elif abs(ll - base) > args.tol:
+            verdict = "SILENT-CORRUPTION"
+            detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            worst = max(worst, 3)
+        else:
+            verdict = "survived"
+            detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            problems = []
+            if not fault_fired(out):
+                problems.append("fault never fired")
+            if report is None:
+                problems.append("no run_report.json")
+            else:
+                if m["sched_recoveries"] < 1:
+                    problems.append("no scheduler recovery observed")
+                if m["sched_journal_replays"] < 1:
+                    problems.append("journal never replayed")
+                if m["retry_give_ups"] > 0:
+                    problems.append(
+                        f"retry_give_ups={m['retry_give_ups']}")
+            if serve:
+                if stats.get("error") and stats["requests"] == 0:
+                    problems.append(stats["error"])
+                elif stats["requests"] < 1:
+                    problems.append("serve driver issued no requests")
+                elif stats["failures"] > 0:
+                    problems.append(
+                        f"{stats['failures']} failed serve requests")
+            if problems:
+                verdict = f"survived ({'; '.join(problems)}!)"
+                worst = max(worst, 1)
+        recov = sched_respawns(out)
+        deltas = metric_deltas(m, base_m, _SCHED_METRIC_KEYS) \
+            if report is not None else "(no run_report.json)"
+        serve_note = (f", serve {stats['requests']} ok /"
+                      f" {stats['failures']} failed" if serve else "")
+        rows.append((f"ps: {name}", verdict, detail, recov, dt, deltas))
+        print(f"[chaos] {name}: {verdict} ({detail.splitlines()[0]}"
+              f"{serve_note}, {recov} sched respawns, {dt:.0f}s)")
+        print(f"[chaos]   metrics vs baseline: {deltas}")
+        print(f"[chaos]   {slo_burn_line(report)}")
+
+    # BSP plane: gbdt with the scheduler killed mid-round, model must be
+    # bit-identical to a fault-free baseline
+    if not args.no_recovery:
+        job, module, argv_fn, _specs = BSP_JOBS[0]
+        bsp_workers = 3
+        for i in range(bsp_workers):
+            synth_libsvm(os.path.join(scratch, f"train-{i}.libsvm"),
+                         args.rows, seed=i)
+        base_model = os.path.join(scratch, f"{job}-sched-baseline.npz")
+        rc, out, dt, _rep = run_bsp_job(
+            module, argv_fn(scratch) + [f"model_out={base_model}"], "",
+            bsp_workers, 0, args.timeout,
+            os.path.join(scratch, f"obs-{job}-sched-baseline"),
+            launcher_args=["--max-scheduler-restarts", str(restarts)])
+        if rc != 0 or not os.path.exists(base_model):
+            print(out[-4000:])
+            print(f"[chaos] {job} baseline (no fault) FAILED rc={rc}")
+            return 2
+        print(f"[chaos] {job} baseline: ok ({dt:.0f}s)")
+        model = os.path.join(scratch, f"{job}-sched-kill.npz")
+        rc, out, dt, report = run_bsp_job(
+            module, argv_fn(scratch) + [f"model_out={model}"],
+            SCHED_BSP_SPEC, bsp_workers, 0, args.timeout,
+            os.path.join(scratch, f"obs-{job}-sched-kill"),
+            launcher_args=["--max-scheduler-restarts", str(restarts)])
+        m = report_metrics(report, _SCHED_METRIC_KEYS)
+        if rc != 0 or not os.path.exists(model):
+            verdict, detail = "FAILED", f"rc={rc}"
+            worst = max(worst, 1)
+            tail = "\n".join(out.splitlines()[-12:])
+            detail += "\n    " + tail.replace("\n", "\n    ")
+        else:
+            same, why = models_equal(base_model, model)
+            if not same:
+                verdict, detail = "SILENT-CORRUPTION", why
+                worst = max(worst, 3)
+            else:
+                verdict, detail = "survived", why
+                problems = []
+                if not fault_fired(out):
+                    problems.append("fault never fired")
+                if report is not None and m["sched_recoveries"] < 1:
+                    problems.append("no scheduler recovery observed")
+                if report is not None and m["retry_give_ups"] > 0:
+                    problems.append(
+                        f"retry_give_ups={m['retry_give_ups']}")
+                if problems:
+                    verdict = f"survived ({'; '.join(problems)}!)"
+                    worst = max(worst, 1)
+        recov = sched_respawns(out)
+        deltas = metric_deltas(m, report_metrics(None, _SCHED_METRIC_KEYS),
+                               _SCHED_METRIC_KEYS) \
+            if report is not None else "(no run_report.json)"
+        rows.append((f"bsp: {SCHED_BSP_SPEC}", verdict, detail, recov,
+                     dt, deltas))
+        print(f"[chaos] {job}: {SCHED_BSP_SPEC}: {verdict} "
+              f"({detail.splitlines()[0]}, {recov} sched respawns, "
+              f"{dt:.0f}s)")
+        print(f"[chaos]   metrics: {deltas}")
+
+    print(f"\n{'scenario':<28} {'verdict':<44} {'respawns':>8} "
+          f"{'sec':>5}")
+    for name, verdict, detail, recov, dt, deltas in rows:
+        print(f"{name:<28} {verdict:<44} {recov:>8} {dt:>5.0f}")
+        print(f"    {detail.splitlines()[0]}")
+        print(f"    {deltas}")
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+    return worst if worst != 1 else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fault-injection matrix for the recovery paths")
@@ -697,6 +1003,14 @@ def main(argv=None) -> int:
                          "judged on convergence parity + membership/"
                          "retry metrics (and a --serve tier that must "
                          "drop zero predict requests during churn)")
+    ap.add_argument("--sched", action="store_true",
+                    help="run the control-plane drill instead of a "
+                         "fault matrix: kill the SCHEDULER itself "
+                         "mid-job (PS plane, PS + --serve load, and the "
+                         "BSP plane) — the launcher respawn + journal "
+                         "replay + exactly-once RPC fence must carry "
+                         "every run to convergence parity with zero "
+                         "retry give-ups and zero failed predicts")
     ap.add_argument("--sync-mode", action="store_true",
                     help="run with WH_ASYNC_SYNC=0 WH_KEYCACHE=0 (the "
                          "pre-overlap synchronous plane); default is "
@@ -720,6 +1034,8 @@ def main(argv=None) -> int:
 
     if args.elastic:
         return elastic_matrix(args)
+    if args.sched:
+        return sched_matrix(args)
     if args.stack == "bsp":
         return bsp_matrix(args)
     if args.plane == "hot":

@@ -154,7 +154,10 @@ def _run_scheduler_bsp(env) -> None:
         sched.start_membership_controller(env.num_workers)
     startup_deadline = time.monotonic() + max(60.0, sched.node_timeout * 4)
     try:
-        seen_any = False
+        # a respawned scheduler (journal replay) already saw workers in a
+        # previous incarnation — the startup deadline must not fire while
+        # the restored group rides out the restart on its retry budgets
+        seen_any = sched.incarnation > 0
         while True:
             time.sleep(0.5)
             seen_any = seen_any or bool(sched.live_workers())
@@ -177,7 +180,10 @@ def _run_scheduler_global(env) -> dict:
     sched.serve()
     startup_deadline = time.monotonic() + max(60.0, sched.node_timeout * 4)
     try:
-        seen_any = False
+        # a respawned scheduler (journal replay) already saw workers in a
+        # previous incarnation — the startup deadline must not fire while
+        # the restored group rides out the restart on its retry budgets
+        seen_any = sched.incarnation > 0
         while True:
             time.sleep(1.0)
             seen_any = seen_any or bool(sched.live_workers())
@@ -432,32 +438,85 @@ def _run_scheduler(cfg, env, verbose: bool) -> dict:
         if env.num_servers > 0:
             ps = _wait_server_group(sched)
             if cfg.model_in:
-                it = cfg.load_iter if cfg.load_iter >= 0 else None
-                ps.load(cfg.model_in, it)
-                if verbose:
-                    print(f"model loaded from {cfg.model_in}"
-                          + (f" iter {cfg.load_iter}"
-                             if cfg.load_iter >= 0 else " (last)"),
-                          flush=True)
-                # release the workers gated on the load (they must not
-                # create fresh tables while servers are still loading)
-                with sched._lock:
-                    sched._blobs[_MODEL_LOADED_KEY] = "1"
-        for dp in range(start_pass, cfg.max_data_pass):
-            n = sched.start_round(cfg.train_data, cfg.num_parts_per_file,
-                                  cfg.data_format, WorkType.TRAIN, dp,
-                                  local_data=getattr(cfg, "local_data",
-                                                     False),
-                                  dispatch=getattr(cfg, "dispatch",
-                                                   "online"))
+                if sched.has_blob(_MODEL_LOADED_KEY):
+                    # respawned scheduler: the journal says the load was
+                    # already commanded before the crash — the PS shards
+                    # hold the (possibly further-trained) model, and
+                    # re-loading would roll their state back
+                    if verbose:
+                        print("model load skipped (already loaded before "
+                              "the scheduler restart)", flush=True)
+                else:
+                    it = cfg.load_iter if cfg.load_iter >= 0 else None
+                    ps.load(cfg.model_in, it)
+                    if verbose:
+                        print(f"model loaded from {cfg.model_in}"
+                              + (f" iter {cfg.load_iter}"
+                                 if cfg.load_iter >= 0 else " (last)"),
+                              flush=True)
+                    # release the workers gated on the load (they must not
+                    # create fresh tables while servers are still loading);
+                    # journaled so a restart does not re-command the load
+                    sched.publish_blob(_MODEL_LOADED_KEY, "1")
+        # resume point from the replayed journal: a respawned scheduler
+        # (incarnation > 0) rejoins the pass loop where the last journaled
+        # round left it instead of re-dispatching from pass 0. An
+        # in-flight round is WAITED OUT (the restored pool still tracks
+        # its unfinished parts — workers keep pulling from it through
+        # their retry budgets); a finished round is skipped.
+        resume_wait = None   # "train" | "val": first pass rejoins mid-round
+        skip_train = False   # TRAIN of the first pass already finished
+        if sched.incarnation > 0 and sched._round is not None:
+            rdp = int(sched._round.get("data_pass", 0))
+            in_flight = not sched.pool.is_finished()
+            if int(sched._round.get("type", 0)) == int(WorkType.TRAIN):
+                start_pass = max(start_pass, rdp)
+                if in_flight:
+                    resume_wait = "train"
+                else:
+                    skip_train = True
+            elif in_flight:    # VAL still running
+                start_pass = max(start_pass, rdp)
+                skip_train = True
+                resume_wait = "val"
+            else:              # VAL finished: the whole pass is done
+                start_pass = max(start_pass, rdp + 1)
+                result["val"] = sched.progress
             if verbose:
-                print(f"training pass {dp}: {n} files", flush=True)
-            result["train"] = sched.wait_round(cfg.print_sec, t0, verbose)
+                print(f"resuming at pass {start_pass} from the scheduler "
+                      f"journal (incarnation {sched.incarnation}"
+                      + (f", waiting out the in-flight {resume_wait} round"
+                         if resume_wait else "") + ")", flush=True)
+        for dp in range(start_pass, cfg.max_data_pass):
+            first = dp == start_pass
+            if not (first and skip_train):
+                if first and resume_wait == "train":
+                    if verbose:
+                        print(f"training pass {dp}: resumed mid-round",
+                              flush=True)
+                else:
+                    n = sched.start_round(cfg.train_data,
+                                          cfg.num_parts_per_file,
+                                          cfg.data_format, WorkType.TRAIN,
+                                          dp,
+                                          local_data=getattr(
+                                              cfg, "local_data", False),
+                                          dispatch=getattr(cfg, "dispatch",
+                                                           "online"))
+                    if verbose:
+                        print(f"training pass {dp}: {n} files", flush=True)
+                result["train"] = sched.wait_round(cfg.print_sec, t0,
+                                                   verbose)
             if cfg.val_data:
-                sched.start_round(cfg.val_data, cfg.num_parts_per_file,
-                                  cfg.data_format, WorkType.VAL, dp)
-                if verbose:
-                    print(f"validation pass {dp}", flush=True)
+                if first and resume_wait == "val":
+                    if verbose:
+                        print(f"validation pass {dp}: resumed mid-round",
+                              flush=True)
+                else:
+                    sched.start_round(cfg.val_data, cfg.num_parts_per_file,
+                                      cfg.data_format, WorkType.VAL, dp)
+                    if verbose:
+                        print(f"validation pass {dp}", flush=True)
                 result["val"] = sched.wait_round(cfg.print_sec, t0, verbose)
             if (ps is not None and cfg.model_out
                     and getattr(cfg, "save_iter", 0) > 0

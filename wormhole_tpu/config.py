@@ -239,6 +239,23 @@ declare_knob("WH_RETRY_CAP_SEC", float, 1.0,
              "Backoff ceiling of the unified retry policy; sleeps never "
              "exceed this (or the budget's remaining deadline).",
              group="faults")
+declare_knob("WH_SCHED_RETRY_SEC", float, 0.0,
+             "Client-side scheduler RPC retry window in seconds (0 = fail "
+             "fast). Retried mutating ops carry a per-sender seq the "
+             "scheduler's journaled reply cache deduplicates, so retries "
+             "stay exactly-once across a scheduler restart. Exported "
+             "automatically by the launcher when --max-scheduler-restarts "
+             "is set.", group="faults")
+declare_knob("WH_SCHED_JOURNAL", bool, True,
+             "Write-ahead journal for the scheduler control plane under "
+             "WH_SNAPSHOT_DIR (sched.journal + sched.snapshot): every "
+             "state-mutating op is fsync'd before the reply is sent, and "
+             "a respawned scheduler replays it to resume the job. Only "
+             "active when WH_SNAPSHOT_DIR is set.", group="faults")
+declare_knob("WH_SCHED_JOURNAL_COMPACT", int, 512,
+             "Compact the scheduler journal into an atomic snapshot once "
+             "this many records accumulated (checked at round starts, the "
+             "quiescent point). 0 disables compaction.", group="faults")
 
 # observability
 declare_knob("WH_OBS_DIR", str, "",

@@ -91,6 +91,7 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            coord_port: int = 0,
            max_server_restarts: int = 0,
            max_worker_restarts: int = 0,
+           max_scheduler_restarts: int = 0,
            num_serve: int = 0,
            max_serve_restarts: int = 0,
            snapshot_dir: str | None = None,
@@ -147,6 +148,17 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     shard that dies mid-job — routers chase the new uri through the
     scheduler's serve_nodes op.
 
+    `max_scheduler_restarts > 0` closes the last single point of
+    failure: the scheduler journals every state-mutating control-plane
+    op under the snapshot dir (WH_SCHED_JOURNAL, on by default), and a
+    scheduler that CRASHES mid-job is respawned on the SAME pinned URI
+    with a bumped incarnation — it replays the journal and resumes the
+    job where it died, while workers ride the outage out under
+    WH_SCHED_RETRY_SEC (exported automatically). Only a clean
+    `announce_shutdown` exit (code 0) tears the job down; crash vs
+    shutdown is distinguished by exit code, fixing the old blanket
+    kill-everything-on-scheduler-exit behavior.
+
     `elastic=True` makes the WORKER SET itself dynamic: WH_ELASTIC=1 is
     exported so the scheduler runs its membership controller
     (WH_ELASTIC_PLAN scripted churn, or gauge-driven sizing), and the
@@ -159,7 +171,9 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
     multi = bool(hosts)
     recovery = max_server_restarts > 0 and num_servers > 0
     recovery_w = max_worker_restarts > 0 and num_workers > 0
-    if (recovery or recovery_w or num_serve > 0) and snapshot_dir is None:
+    recovery_s = max_scheduler_restarts > 0
+    if (recovery or recovery_w or recovery_s
+            or num_serve > 0) and snapshot_dir is None:
         import tempfile
 
         snapshot_dir = tempfile.mkdtemp(prefix="wh_ps_snap_")
@@ -221,6 +235,11 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
             # survivor-side stall budget for a blocked BSP collective:
             # must span a worker death + respawn + checkpoint load
             env["WH_BSP_RETRY_SEC"] = str(max(120.0, node_timeout * 4))
+        if recovery_s and not os.environ.get("WH_SCHED_RETRY_SEC"):
+            # client-side scheduler-RPC retry window: must span a
+            # scheduler death + respawn + journal replay; the reply
+            # cache keeps the retries exactly-once
+            env["WH_SCHED_RETRY_SEC"] = str(max(120.0, node_timeout * 4))
         if env_extra:
             env.update({k: str(v) for k, v in env_extra.items()})
         return env
@@ -413,7 +432,31 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
         m.start()
         monitors.append(m)
     try:
-        rc = sched.wait()
+        # scheduler supervision: a CLEAN exit (code 0, after
+        # announce_shutdown) tears the job down; a crash respawns the
+        # scheduler on the same pinned URI — it replays its journal and
+        # resumes — while workers ride their WH_SCHED_RETRY_SEC budgets.
+        # Without supervision every scheduler exit tears down (legacy).
+        sched_restarts = 0
+        while True:
+            rc = sched.wait()
+            if rc == 0 or not recovery_s or stop_respawn.is_set():
+                break
+            if sched_restarts >= max_scheduler_restarts:
+                print(f"[dmlc_tpu] ERROR: scheduler died again "
+                      f"(exit {rc}) and max_scheduler_restarts="
+                      f"{max_scheduler_restarts} is exhausted; not "
+                      "respawning — the job will fail", flush=True)
+                break
+            sched_restarts += 1
+            print(f"[dmlc_tpu] scheduler died (exit {rc}); respawning "
+                  f"on {uri} with journal replay "
+                  f"({sched_restarts}/{max_scheduler_restarts})",
+                  flush=True)
+            sched = spawn("scheduler", 0,
+                          {"WH_RESTORE_EPOCH": str(sched_restarts)})
+            procs["scheduler"] = sched
+            watch_output("scheduler", sched, on_line=scrape_report)
         stop_respawn.set()  # teardown begins: server exits are expected
         # give workers a grace period to drain, then terminate leftovers.
         # A signal death is a NEGATIVE returncode — fold it to a
@@ -478,6 +521,13 @@ def main(argv=None) -> int:
                          "(BSP allreduce apps recover it from its "
                          "version checkpoint; 0 = a worker death fails "
                          "the job)")
+    ap.add_argument("--max-scheduler-restarts", type=int, default=0,
+                    help="respawn a crashed scheduler up to N times on "
+                         "the same pinned URI; it replays its "
+                         "control-plane journal (WH_SCHED_JOURNAL under "
+                         "the snapshot dir) and resumes the job while "
+                         "clients retry under WH_SCHED_RETRY_SEC "
+                         "(0 = legacy: any scheduler exit ends the job)")
     ap.add_argument("--serve", type=int, default=0, dest="num_serve",
                     help="online serving shards to run alongside the "
                          "job (serving/server.py): each serves its "
@@ -554,6 +604,7 @@ def main(argv=None) -> int:
                   coord_port=args.coord_port,
                   max_server_restarts=args.max_server_restarts,
                   max_worker_restarts=args.max_worker_restarts,
+                  max_scheduler_restarts=args.max_scheduler_restarts,
                   num_serve=args.num_serve,
                   max_serve_restarts=args.max_serve_restarts,
                   snapshot_dir=args.snapshot_dir,

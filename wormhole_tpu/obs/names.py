@@ -38,6 +38,12 @@ COUNTERS: dict[str, str] = {
     "ps.hot.flushes": "hot-plane cold-tier flush round-trips",
     "sched.liveness_evictions": "nodes evicted by the liveness loop",
     "sched.server_recoveries": "server re-registrations after death",
+    "sched.recoveries": "scheduler restarts resumed from the journal",
+    "sched.rpc.dedup_hits": "retried scheduler RPCs answered from the reply cache",
+    "sched.journal.appends": "records appended to the scheduler journal",
+    "sched.journal.bytes": "bytes fsync'd into the scheduler journal",
+    "sched.journal.replays": "journal records replayed at scheduler start",
+    "sched.journal.compactions": "journal compactions into a state snapshot",
     "bsp.rounds": "BSP collective rounds completed (allreduce+broadcast)",
     "bsp.recoveries": "BSP worker re-registrations after death",
     "bsp.ring_retries": "ring rounds aborted and replayed on a gen bump",
@@ -92,6 +98,7 @@ GAUGES: dict[str, str] = {
     "loader.pool_size": "current loader thread-pool size",
     "pack_cache.bytes": "bytes held by the pack cache memory tier",
     "obs.ring.depth": "snapshots held by the scheduler's telemetry ring",
+    "sched.incarnation": "scheduler incarnation number (0 = never restarted)",
     "slo.*_burn": "error-budget burn rate per declared SLO (>1 = violated)",
 }
 
@@ -155,6 +162,7 @@ EVENTS: dict[str, str] = {
     "sched.serve_recovered": "scheduler accepted a serving-shard re-registration",
     "sched.bsp_recovered": "scheduler accepted a BSP worker re-registration",
     "sched.liveness_evict": "scheduler evicted an unresponsive node",
+    "sched.resumed": "respawned scheduler resumed state from its journal",
     "sched.member_join": "scheduler admitted a worker into a running job",
     "sched.member_leave": "scheduler processed a worker's clean leave",
 }

@@ -126,6 +126,12 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "retry_attempts": c.get("retry.attempts", 0),
         "retry_successes": c.get("retry.successes", 0),
         "retry_give_ups": c.get("retry.give_ups", 0),
+        "sched_recoveries": c.get("sched.recoveries", 0),
+        "sched_incarnation": int(g.get("sched.incarnation", 0) or 0),
+        "sched_journal_appends": c.get("sched.journal.appends", 0),
+        "sched_journal_replays": c.get("sched.journal.replays", 0),
+        "sched_journal_compactions": c.get("sched.journal.compactions", 0),
+        "sched_rpc_dedup_hits": c.get("sched.rpc.dedup_hits", 0),
     }
     report = {
         "run_id": run_id or os.environ.get("WH_RUN_ID"),
@@ -217,6 +223,14 @@ def format_lines(report: dict) -> list[str]:
             f"  membership: epochs={s['membership_epochs']} "
             f"joins={s['worker_joins']} leaves={s['worker_leaves']} "
             f"rehellos={s['ps_rehellos']}")
+    if s.get("sched_recoveries") or s.get("sched_journal_appends"):
+        lines.append(
+            f"  control plane: recoveries={s['sched_recoveries']} "
+            f"incarnation={s['sched_incarnation']} "
+            f"journal_appends={s['sched_journal_appends']} "
+            f"replays={s['sched_journal_replays']} "
+            f"compactions={s['sched_journal_compactions']} "
+            f"rpc_dedup={s['sched_rpc_dedup_hits']}")
     if s.get("retry_attempts") or s.get("retry_give_ups"):
         lines.append(
             f"  retry policy: attempts={s['retry_attempts']} "

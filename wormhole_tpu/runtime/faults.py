@@ -52,6 +52,14 @@ Spec grammar (comma-separated specs; all counters are deterministic):
     sched:drop@<op>:<nth>
         the scheduler answers the <nth> request of <op> with an error
         (a dropped/garbled control message). Arms in the scheduler.
+    sched:kill@<op>:<nth>[:always]
+        the scheduler process hard-exits (os._exit) on its <nth>
+        dispatch of <op> ('any' matches every op), BEFORE the op's
+        effect is applied or journaled — so the dying request is the
+        client retry's problem, never a double-applied one. Mirrors
+        the server/worker kill grammar: arms only in the first
+        incarnation unless ':always'. Pair with the launcher's
+        --max-scheduler-restarts to exercise journal replay.
 
 Example: WH_FAULT_SPEC="server:1:kill@push:200" kills server rank 1 on
 its 200th push.
@@ -115,6 +123,7 @@ class Faults:
         self._delay_s = 0.0
         self._reset_after: Optional[int] = None
         self._drops: list[tuple[str, int]] = []   # (op, nth)
+        self._skills: list[tuple[str, int]] = []  # (op, nth) sched kills
         self._partitions: dict[str, float] = {}   # op -> secs
         self._partition_t0: dict[str, float] = {}  # op -> first-send time
         self._slows: dict[str, float] = {}        # op -> sleep seconds
@@ -181,9 +190,16 @@ class Faults:
                 else:
                     raise FaultSpecError(f"unknown net fault {f[1]!r}")
             elif f[0] == "sched":
-                op, nth, _ = _parse_at(":".join(f[1:]), "drop")
-                if role == "scheduler":
-                    self._drops.append((op, nth))
+                rest = ":".join(f[1:])
+                if rest.startswith("kill@"):
+                    op, nth, always = _parse_at(rest, "kill")
+                    if (role == "scheduler"
+                            and (always or self.epoch == 0)):
+                        self._skills.append((op, nth))
+                else:
+                    op, nth, _ = _parse_at(rest, "drop")
+                    if role == "scheduler":
+                        self._drops.append((op, nth))
             else:
                 raise FaultSpecError(f"unknown fault kind {f[0]!r} in {s!r}")
 
@@ -274,14 +290,25 @@ class Faults:
                 self.kill_fn(KILL_EXIT)
 
     def sched_op(self, op) -> None:
-        """At every Scheduler dispatch; may raise to drop the request."""
-        if not self._drops:
+        """At every Scheduler dispatch; may raise to drop the request,
+        or hard-exit the process (sched:kill). The hook runs BEFORE the
+        op's effect/journal append, so a killed request was never
+        applied — the client's retry re-executes it in the next
+        incarnation, still exactly-once."""
+        if not self._drops and not self._skills:
             return
         with self._lock:
             self._sched_counts[op] = self._sched_counts.get(op, 0) + 1
-            n = self._sched_counts[op]
+            n_op = self._sched_counts[op]
+            n_any = sum(self._sched_counts.values())
+        for want, nth in self._skills:
+            n = n_any if want == "any" else (n_op if want == op else 0)
+            if n == nth:
+                print(f"[faults] scheduler killing itself at "
+                      f"{want!r} #{nth} (epoch {self.epoch})", flush=True)
+                self.kill_fn(KILL_EXIT)
         for want, nth in self._drops:
-            if want in (op, "any") and n == nth:
+            if want in (op, "any") and n_op == nth:
                 raise ConnectionError(
                     f"fault injected: sched:drop {op!r} #{nth}")
 

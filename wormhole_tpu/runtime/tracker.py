@@ -24,6 +24,7 @@ spawns the node processes and wires the env vars.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import os
 import socket
@@ -41,6 +42,7 @@ from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime import retry as _retry
 from wormhole_tpu.runtime.net import connect_with_retry
+from wormhole_tpu.runtime.sched_journal import SchedulerJournal
 from wormhole_tpu.solver.progress import Progress
 from wormhole_tpu.solver.workload import File, WorkloadPool, WorkType
 
@@ -54,6 +56,30 @@ _RING_DEPTH = _obs.REGISTRY.gauge("obs.ring.depth")
 _MEPOCHS = _obs.REGISTRY.counter("sched.membership_epochs")
 _JOINS = _obs.REGISTRY.counter("sched.joins")
 _LEAVES = _obs.REGISTRY.counter("sched.leaves")
+_RECOVERIES = _obs.REGISTRY.counter("sched.recoveries")
+_DEDUP_HITS = _obs.REGISTRY.counter("sched.rpc.dedup_hits")
+_INCARNATION = _obs.REGISTRY.gauge("sched.incarnation")
+
+# Client ops that mutate scheduler state: these carry a per-sender
+# sequence number so a retried RPC (lost reply, scheduler restart)
+# deduplicates against the reply cache instead of re-executing.
+_MUTATING_OPS = frozenset({
+    "join", "leave", "register", "register_server", "register_serve",
+    "register_bsp", "bsp_leave", "get", "add_local", "finish", "report",
+    "blob_put", "blob_del", "barrier", "bye",
+})
+
+# Server-side: which ops append an RPC record to the write-ahead
+# journal.  `get` is special-cased — only journaled when it actually
+# assigned a part (the assignment is replayed verbatim; `get` picks
+# randomly so re-dispatching it would re-roll the choice).  Pure reads
+# (epoch, servers, bsp_peers, serve_nodes, blob_get, barrier_wait,
+# metrics, elastic) are never journaled.
+_JOURNALED_OPS = frozenset({
+    "join", "leave", "register", "register_server", "register_serve",
+    "register_bsp", "bsp_leave", "add_local", "finish", "report",
+    "blob_put", "blob_del", "barrier", "bye",
+})
 
 
 def _worker_rank(node: str) -> int:
@@ -141,10 +167,12 @@ class Scheduler:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  node_timeout: float = 30.0, straggler: bool = True,
-                 num_servers: int = 0, num_workers: int = 0):
+                 num_servers: int = 0, num_workers: int = 0,
+                 journal_dir: Optional[str] = None):
         self.pool = WorkloadPool()
         self.num_workers = num_workers
         self._collect: "Optional[dict]" = None  # worker-local-data round
+        self._round: "Optional[dict]" = None     # current dispatch round
         self.progress = Progress()
         self.node_timeout = node_timeout
         self.num_servers = num_servers
@@ -191,6 +219,24 @@ class Scheduler:
         self._srv = _Server((host, port), _Handler)
         self._srv.scheduler = self  # type: ignore
         self._threads: list[threading.Thread] = []
+        # exactly-once RPC: last (seq, reply) per sender — a retried op
+        # whose reply was lost returns the cached reply instead of
+        # re-executing; an OLDER seq is fenced as a pre-restart ghost
+        self._replies: dict[str, tuple[int, dict]] = {}
+        # durable control plane: write-ahead journal + replay (see
+        # runtime/sched_journal.py). Replay runs BEFORE the straggler
+        # killer starts so restored assignments cannot be re-queued
+        # while the journal is still being applied.
+        self._replaying = False
+        self.incarnation = 0
+        self._served_at = time.monotonic()
+        self._compact_every = int(knob_value("WH_SCHED_JOURNAL_COMPACT"))
+        self._journal: Optional[SchedulerJournal] = None
+        if journal_dir:
+            self._journal = SchedulerJournal(journal_dir)
+            self._replay_journal()
+            self.pool.on_requeue = self._journal_requeue
+        _INCARNATION.set(float(self.incarnation))
         if straggler:
             self.pool.start_straggler_killer()
 
@@ -201,6 +247,7 @@ class Scheduler:
         return f"{h}:{p}"
 
     def serve(self) -> None:
+        self._served_at = time.monotonic()
         t = threading.Thread(target=self._srv.serve_forever, daemon=True)
         t.start()
         self._threads.append(t)
@@ -216,9 +263,13 @@ class Scheduler:
 
     def announce_shutdown(self) -> None:
         """Mark the job finished; workers see it on their next epoch poll
-        and exit their dispatch loop."""
+        and exit their dispatch loop. Journaled — a scheduler respawned
+        after a crash-during-drain resumes already shut down instead of
+        restarting the pass loop."""
         with self._lock:
             self._shutdown = True
+        if self._journal is not None:
+            self._journal.record({"k": "shutdown"})
 
     def stop(self) -> None:
         self._done = True
@@ -230,18 +281,285 @@ class Scheduler:
             self._scrape_srv = None
         self._srv.shutdown()
         self._srv.server_close()
+        if self._journal is not None:
+            self._journal.close()
 
     @staticmethod
     def from_env(env) -> "Scheduler":
         """Bind the scheduler on the URI the launcher allocated
-        (WH_SCHEDULER_URI)."""
+        (WH_SCHEDULER_URI). When the launcher provides a snapshot dir
+        (and WH_SCHED_JOURNAL is not disabled), the control plane
+        journals there — a respawned scheduler replays it and resumes
+        the job instead of restarting it."""
         host, port = env.scheduler_uri.rsplit(":", 1)
+        jdir = os.environ.get("WH_SNAPSHOT_DIR") or None
+        if jdir and not knob_value("WH_SCHED_JOURNAL"):
+            jdir = None
         return Scheduler(
             host=host, port=int(port),
             node_timeout=float(os.environ.get("WH_NODE_TIMEOUT", "30")),
             num_servers=env.num_servers,
             num_workers=env.num_workers,
+            journal_dir=jdir,
         )
+
+    # -- durable control plane (journal + replay) ---------------------------
+    def _replay_journal(self) -> None:
+        """Restore state from the snapshot + journal tail. Called from
+        __init__ (before any RPC thread exists); a corrupt record is
+        skipped with a warning rather than bricking the respawn."""
+        snap, records, max_inc = self._journal.load()
+        had_state = snap is not None or bool(records)
+        self._replaying = True
+        try:
+            if snap is not None:
+                self._restore_state(snap)
+            for rec in records:
+                try:
+                    self._apply_record(rec)
+                except Exception as e:
+                    print(f"[sched-journal] skipping bad "
+                          f"{rec.get('k')!r} record: {e!r}", flush=True)
+        finally:
+            self._replaying = False
+        self.incarnation = (max_inc + 1) if had_state else 0
+        self._journal.record({"k": "inc", "inc": self.incarnation})
+        if self.incarnation > 0:
+            _RECOVERIES.inc()
+            _trace.event("sched.resumed", cat="recovery",
+                         inc=self.incarnation, records=len(records),
+                         snapshot=snap is not None)
+            print(f"[recovery] scheduler resumed at incarnation "
+                  f"{self.incarnation} (snapshot="
+                  f"{'yes' if snap else 'no'}, {len(records)} journal "
+                  f"records replayed; epoch {self._epoch}, mepoch "
+                  f"{self._mepoch})", flush=True)
+
+    def _apply_record(self, rec: dict) -> None:
+        """Re-apply one journal record during replay (chronological)."""
+        k = rec.get("k")
+        if k == "inc":
+            return
+        if k == "rpc":
+            req = rec["req"]
+            op = req.get("op")
+            resp = rec.get("resp", {})
+            if op == "get":
+                # `get` picks randomly — apply the journaled choice
+                # instead of re-rolling a different assignment
+                if "part_id" in resp:
+                    self.pool.assign_part(int(resp["part_id"]),
+                                          req.get("node", "?"),
+                                          resp.get("mepoch"))
+            else:
+                self._dispatch_op(op, req)
+            sender, seq = req.get("sender"), req.get("seq")
+            if sender is not None and seq is not None:
+                # the cache holds the JOURNALED reply, not a recomputed
+                # one — a post-restart retry must see the original
+                with self._lock:
+                    prev = self._replies.get(sender)
+                    if prev is None or int(seq) >= prev[0]:
+                        self._replies[sender] = (int(seq), resp)
+            return
+        if k == "round":
+            self._apply_round_record(rec)
+            return
+        if k == "evict":
+            n = rec["node"]
+            _EVICTIONS.inc()
+            with self._lock:
+                self._nodes.pop(n, None)
+            self._handle_dead_node(n)
+            return
+        if k == "requeue":
+            self.pool.requeue_parts([int(i) for i in rec.get("parts", [])])
+            return
+        if k == "shutdown":
+            with self._lock:
+                self._shutdown = True
+            return
+        if k == "blob":
+            with self._lock:
+                self._blobs[rec["key"]] = rec["data"]
+            return
+        print(f"[sched-journal] unknown record kind {k!r}; skipped",
+              flush=True)
+
+    def _apply_round_record(self, rec: dict) -> None:
+        self.pool.clear()
+        with self._lock:
+            self.progress = Progress()
+            self._epoch = int(rec["epoch"])
+            self._round = rec["round"]
+            c = rec.get("collect")
+            self._collect = (dict(pattern=c["pattern"], npp=c["npp"],
+                                  fmt=c["fmt"],
+                                  reported=set(c.get("reported", [])))
+                             if c else None)
+        if rec.get("parts") is not None:
+            self.pool.load_state(rec["parts"])
+
+    def _journal_round(self) -> None:
+        """Append the round record (epoch, round, collect, pool fill)
+        right after a round becomes visible. Also the compaction hook:
+        round starts are the only quiescent point where no non-idempotent
+        record (report/finish progress) can straddle the snapshot."""
+        if self._journal is None:
+            return
+        if (self._compact_every > 0
+                and self._journal.appends_since_compact
+                >= self._compact_every):
+            self._journal.compact(self._durable_state)
+            print(f"[sched-journal] compacted into snapshot "
+                  f"(epoch {self._epoch})", flush=True)
+        with self._lock:
+            rec = {"k": "round", "epoch": self._epoch,
+                   "round": dict(self._round),
+                   "collect": (dict(pattern=self._collect["pattern"],
+                                    npp=self._collect["npp"],
+                                    fmt=self._collect["fmt"],
+                                    reported=sorted(
+                                        self._collect["reported"]))
+                               if self._collect is not None else None)}
+        rec["parts"] = self.pool.export_state()
+        self._journal.record(rec)
+
+    def _journal_requeue(self, part_ids: list) -> None:
+        """pool.on_requeue hook: the straggler watchdog re-queued parts;
+        journal it so a replayed pool agrees about ownership (owner
+        cleared, membership stamp kept)."""
+        if self._journal is not None and not self._replaying:
+            self._journal.record({"k": "requeue", "parts": list(part_ids)})
+
+    def _record_op(self, op, req: dict, resp: dict,
+                   sender, seq) -> None:
+        """Cache the reply (exactly-once dedup) and append the RPC
+        record. WAL order is effect -> journal -> reply: a crash between
+        effect and journal loses the effect, but the reply was never
+        sent, so the client's retry re-executes it — still exactly
+        once."""
+        if "error" in resp:
+            return
+        with self._lock:
+            self._replies[sender] = (int(seq), resp)
+        if self._journal is None or self._replaying:
+            return
+        if op not in _JOURNALED_OPS and not (op == "get"
+                                             and "part_id" in resp):
+            return
+        jreq = dict(req)
+        if op not in ("bye", "leave"):
+            # heartbeat-piggybacked metrics snapshots are bulky and
+            # refresh within seconds of a respawn; only the FINAL
+            # snapshot a departing node sends is worth replaying
+            jreq.pop("metrics", None)
+        self._journal.record({"k": "rpc", "req": jreq, "resp": resp})
+
+    def _durable_state(self) -> dict:
+        """Everything a respawned scheduler needs, as one JSON-able
+        snapshot (the compaction target). URI maps are stored as
+        [rank, uri] pairs — JSON would silently turn int keys into
+        strings. Counter values ride along so the end-of-run report
+        adds up across incarnations."""
+        with self._lock:
+            state = {
+                "inc": self.incarnation,
+                "epoch": self._epoch,
+                "round": self._round,
+                "collect": (dict(pattern=self._collect["pattern"],
+                                 npp=self._collect["npp"],
+                                 fmt=self._collect["fmt"],
+                                 reported=sorted(
+                                     self._collect["reported"]))
+                            if self._collect is not None else None),
+                "mepoch": self._mepoch,
+                "members": sorted(self._members),
+                "retiring": sorted(self._retiring),
+                "seen_workers": sorted(self._seen_workers),
+                "blobs": dict(self._blobs),
+                "server_uris": [[r, u] for r, u
+                                in sorted(self._server_uris.items())],
+                "serve_uris": [[r, u] for r, u
+                               in sorted(self._serve_uris.items())],
+                "bsp_uris": [[r, u] for r, u
+                             in sorted(self._bsp_uris.items())],
+                "bsp_gen": self._bsp_gen,
+                "bsp_ready": self._bsp_ready,
+                "barrier_gen": dict(self._barrier_gen),
+                "barriers": {k: sorted(v)
+                             for k, v in self._barriers.items()},
+                "shutdown": self._shutdown,
+                "replies": {s: [q, r]
+                            for s, (q, r) in self._replies.items()},
+                "recoveries": [self.num_server_recoveries,
+                               self.num_serve_recoveries,
+                               self.num_bsp_recoveries],
+                "node_metrics": dict(self._node_metrics),
+                "progress": dict(self.progress.tot),
+            }
+        counters = _obs.REGISTRY.snapshot()["counters"]
+        state["counters"] = {
+            n: v for n, v in counters.items()
+            if v and (n.startswith("sched.") or n == "bsp.recoveries")
+        }
+        state["pool"] = self.pool.export_state()
+        return state
+
+    def _restore_state(self, s: dict) -> None:
+        with self._lock:
+            self._epoch = int(s.get("epoch", 0))
+            self._round = s.get("round")
+            c = s.get("collect")
+            self._collect = (dict(pattern=c["pattern"], npp=c["npp"],
+                                  fmt=c["fmt"],
+                                  reported=set(c.get("reported", [])))
+                             if c else None)
+            self._mepoch = int(s.get("mepoch", 0))
+            self._members = set(s.get("members", []))
+            self._retiring = set(s.get("retiring", []))
+            self._seen_workers = set(s.get("seen_workers", []))
+            self._blobs = dict(s.get("blobs", {}))
+            self._server_uris = {int(r): u
+                                 for r, u in s.get("server_uris", [])}
+            self._serve_uris = {int(r): u
+                                for r, u in s.get("serve_uris", [])}
+            self._bsp_uris = {int(r): u
+                              for r, u in s.get("bsp_uris", [])}
+            self._bsp_gen = int(s.get("bsp_gen", 0))
+            self._bsp_ready = bool(s.get("bsp_ready", False))
+            self._barrier_gen = {k: int(v) for k, v
+                                 in s.get("barrier_gen", {}).items()}
+            self._barriers = {k: set(v) for k, v
+                              in s.get("barriers", {}).items()}
+            self._shutdown = bool(s.get("shutdown", False))
+            self._replies = {snd: (int(q), r) for snd, (q, r)
+                             in s.get("replies", {}).items()}
+            rec = s.get("recoveries", [0, 0, 0])
+            self.num_server_recoveries = int(rec[0])
+            self.num_serve_recoveries = int(rec[1])
+            self.num_bsp_recoveries = int(rec[2])
+            self._node_metrics = dict(s.get("node_metrics", {}))
+            self.progress.merge(s.get("progress", {}))
+        for name, v in (s.get("counters") or {}).items():
+            if v:
+                _obs.REGISTRY.counter(name).inc(int(v))
+        if s.get("pool"):
+            self.pool.load_state(s["pool"])
+
+    def publish_blob(self, key: str, data: str) -> None:
+        """Scheduler-side blob publish, journaled (unlike a direct
+        _blobs poke) so it survives a restart — e.g. the runner's
+        model-loaded marker must not cause a respawned scheduler to
+        re-load the input model over live training state."""
+        with self._lock:
+            self._blobs[key] = data
+        if self._journal is not None:
+            self._journal.record({"k": "blob", "key": key, "data": data})
+
+    def has_blob(self, key: str) -> bool:
+        with self._lock:
+            return key in self._blobs
 
     # -- dispatch round management -----------------------------------------
     def start_round(self, pattern: str, num_parts_per_file: int,
@@ -257,29 +575,30 @@ class Scheduler:
         (WorkloadPool.is_finished) rather than as an instantly-over
         round."""
         self.pool.clear()
+        # worker-local data (reference data_parallel.h:82,96-100):
+        # workers match the pattern against THEIR filesystems and
+        # report; parts then carry node affinity
+        collect = (dict(pattern=pattern, npp=num_parts_per_file,
+                        fmt=fmt, reported=set())
+                   if local_data else None)
         with self._lock:
             # rebind under the lock: handler threads merge() into the
             # current Progress and must not see a half-published swap
             self.progress = Progress()
             self._epoch += 1
             self._round = dict(type=int(wtype), data_pass=data_pass)
-            if local_data:
-                # worker-local data (reference data_parallel.h:82,96-100):
-                # workers match the pattern against THEIR filesystems and
-                # report; parts then carry node affinity
-                self._collect = dict(pattern=pattern,
-                                     npp=num_parts_per_file, fmt=fmt,
-                                     reported=set())
-                return 0
-            self._collect = None
-        n = self.pool.add(pattern, num_parts_per_file, fmt)
-        if n == 0:
-            raise FileNotFoundError(f"no files match {pattern}")
-        if dispatch == "batch" and self.num_workers > 0:
-            # stable n/num_workers assignment, unchanged between passes
-            # (reference batch mode, data_parallel.h:54-60)
-            self.pool.assign_stable(
-                [f"worker-{r}" for r in range(self.num_workers)])
+            self._collect = collect
+        n = 0
+        if not local_data:
+            n = self.pool.add(pattern, num_parts_per_file, fmt)
+            if n == 0:
+                raise FileNotFoundError(f"no files match {pattern}")
+            if dispatch == "batch" and self.num_workers > 0:
+                # stable n/num_workers assignment, unchanged between
+                # passes (reference batch mode, data_parallel.h:54-60)
+                self.pool.assign_stable(
+                    [f"worker-{r}" for r in range(self.num_workers)])
+        self._journal_round()
         return n
 
     def _round_finished(self) -> bool:
@@ -353,13 +672,39 @@ class Scheduler:
         op = req.get("op")
         t0 = time.perf_counter()
         try:
-            return self._dispatch_op(op, req)
+            sender, seq = req.get("sender"), req.get("seq")
+            if sender is not None and seq is not None:
+                with self._lock:
+                    cached = self._replies.get(sender)
+                if cached is not None:
+                    if seq == cached[0]:
+                        # duplicate of this sender's last applied op (a
+                        # retry whose reply was lost, possibly across a
+                        # restart): return the recorded reply instead
+                        # of re-executing — exactly-once
+                        _DEDUP_HITS.inc()
+                        resp = dict(cached[1])
+                        resp["inc"] = self.incarnation
+                        return resp
+                    if seq < cached[0]:
+                        # incarnation fence: an older seq can only be a
+                        # ghost from before a restart
+                        return {"error": f"stale scheduler seq {seq} < "
+                                         f"{cached[0]} from {sender}",
+                                "inc": self.incarnation}
+            resp = self._dispatch_op(op, req)
+            resp["inc"] = self.incarnation
+            if sender is not None and seq is not None:
+                self._record_op(op, req, resp, sender, seq)
+            return resp
         finally:
             _obs.REGISTRY.histogram(f"sched.op.{op}_s").observe(
                 time.perf_counter() - t0)
 
     def _dispatch_op(self, op, req: dict) -> dict:
-        if faults.ACTIVE is not None:
+        if faults.ACTIVE is not None and not self._replaying:
+            # journal replay re-runs recorded ops; armed faults (drops,
+            # kills) must not fire on historical traffic
             faults.ACTIVE.sched_op(op)
         node = req.get("node", "?")
         snap = req.get("metrics")
@@ -858,6 +1203,13 @@ class Scheduler:
         remain live — the shutdown-drain condition (a fast worker's
         deregistration must not read as 'everyone finished' while a
         slow-starting peer has yet to register)."""
+        if (self.incarnation > 0
+                and time.monotonic() - self._served_at < 6.0):
+            # a respawned scheduler's liveness table starts from the
+            # replayed journal, which may be empty of live workers; let
+            # the LivenessPinger cadence (2s) repopulate it before
+            # trusting emptiness as "drained"
+            return False
         with self._lock:
             if len(self._seen_workers) < expect:
                 return False
@@ -883,75 +1235,138 @@ class Scheduler:
             if dead:
                 _EVICTIONS.inc(len(dead))
             for n in dead:
-                _trace.event("sched.liveness_evict", cat="recovery", node=n)
-                if n.startswith("server"):
-                    # servers carry no pool parts; their loss is its own
-                    # first-class event (the launcher's respawn loop — if
-                    # enabled — brings the process back; workers ride it
-                    # out through the PSClient retry path)
-                    print(f"[recovery] ps {n} lost from the liveness "
-                          "plane (no epoch ping for "
-                          f"{self.node_timeout:.0f}s); awaiting respawn "
-                          "or worker-side retry failure", flush=True)
-                    continue
-                requeued = self.pool.reset(n)
-                if requeued:
-                    print(f"node {n} lost; re-queued {requeued} parts",
-                          flush=True)
-                released, skipped = self.pool.drop_node(n)
-                if skipped:
-                    print(f"node {n} lost; {skipped} parts only it could "
-                          "read are skipped", flush=True)
-                if n.startswith("worker"):
-                    # a declared-dead worker is a membership change: the
-                    # epoch bump (plus the assignment reset above, which
-                    # clears the parts' owner/epoch stamps) fences any
-                    # late completion the node sends if it comes back
-                    with self._lock:
-                        self._members.discard(n)
-                        self._retiring.discard(n)
-                    self._member_change("evict", n)
-                with self._lock:
-                    if (self._collect is not None
-                            and n not in self._collect["reported"]):
-                        # a dead worker will never report its local files;
-                        # count it as reported-empty so the round can end
-                        # (its data is unreachable, like the reference
-                        # losing a node's local disk)
-                        self._collect["reported"].add(n)
-                        print(f"node {n} lost before reporting local "
-                              "files; its data is skipped", flush=True)
+                if self._journal is not None:
+                    self._journal.record({"k": "evict", "node": n})
+                self._handle_dead_node(n)
+
+    def _handle_dead_node(self, n: str) -> None:
+        """Evict one node that dropped off the liveness plane (shared
+        between the watchdog and journal replay of `evict` records)."""
+        _trace.event("sched.liveness_evict", cat="recovery", node=n)
+        if n.startswith("server"):
+            # servers carry no pool parts; their loss is its own
+            # first-class event (the launcher's respawn loop — if
+            # enabled — brings the process back; workers ride it
+            # out through the PSClient retry path)
+            print(f"[recovery] ps {n} lost from the liveness "
+                  "plane (no epoch ping for "
+                  f"{self.node_timeout:.0f}s); awaiting respawn "
+                  "or worker-side retry failure", flush=True)
+            return
+        requeued = self.pool.reset(n)
+        if requeued:
+            print(f"node {n} lost; re-queued {requeued} parts",
+                  flush=True)
+        released, skipped = self.pool.drop_node(n)
+        if skipped:
+            print(f"node {n} lost; {skipped} parts only it could "
+                  "read are skipped", flush=True)
+        if n.startswith("worker"):
+            # a declared-dead worker is a membership change: the
+            # epoch bump (plus the assignment reset above, which
+            # clears the parts' owner/epoch stamps) fences any
+            # late completion the node sends if it comes back
+            with self._lock:
+                self._members.discard(n)
+                self._retiring.discard(n)
+            self._member_change("evict", n)
+        with self._lock:
+            if (self._collect is not None
+                    and n not in self._collect["reported"]):
+                # a dead worker will never report its local files;
+                # count it as reported-empty so the round can end
+                # (its data is unreachable, like the reference
+                # losing a node's local disk)
+                self._collect["reported"].add(n)
+                print(f"node {n} lost before reporting local "
+                      "files; its data is skipped", flush=True)
 
 
 # ------------------------------------------------------------------ client
+_CLIENT_NONCE = itertools.count()
+
+
 class SchedulerClient:
-    """Worker-side RPC stub."""
+    """Worker-side RPC stub.
+
+    Mutating ops carry a per-sender sequence number; the scheduler
+    caches the last reply per sender (journaled), so a retried op whose
+    reply was lost — or that straddled a scheduler restart — returns
+    the ORIGINAL reply instead of re-executing. That is what makes
+    retrying safe here: without it, ops like barrier entry and part
+    assignment would double-apply. `retry_deadline` (default: the
+    launcher-exported WH_SCHED_RETRY_SEC; 0 = legacy fail-fast) bounds
+    how long a lost connection/reply is retried under the unified
+    retry budget."""
 
     def __init__(self, uri: str, node: str, timeout: float = 60.0,
-                 connect_deadline: float = 30.0):
+                 connect_deadline: float = 30.0,
+                 retry_deadline: Optional[float] = None):
         host, port = uri.rsplit(":", 1)
         self.addr = (host, int(port))
         self.node = node
         self.timeout = timeout
         self.connect_deadline = connect_deadline
+        if retry_deadline is None:
+            retry_deadline = float(
+                os.environ.get("WH_SCHED_RETRY_SEC", "0") or 0.0)
+        self.retry_deadline = retry_deadline
+        # per-INSTANCE sender id: a client re-created in the same
+        # process (an in-process respawn, e.g. a BSP rank rejoining)
+        # is a new logical sender with a fresh seq space — it must not
+        # be fenced by its dead predecessor's cached seq.
+        self._sender = f"{node}:{os.getpid()}.{next(_CLIENT_NONCE)}"
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._inc: Optional[int] = None  # last incarnation seen
 
     def call(self, **req) -> dict:
-        """One RPC. Only connection ESTABLISHMENT retries (the launcher
-        spawns workers concurrently with the scheduler, so a worker's
-        first register() may race ahead of the scheduler's bind, ADVICE
-        r1); once connected, a lost reply raises rather than replaying —
-        ops like barrier entry and part assignment are not idempotent."""
+        """One exactly-once RPC. Connection establishment always
+        retries under `connect_deadline` (the launcher spawns workers
+        concurrently with the scheduler, ADVICE r1). With a positive
+        `retry_deadline`, a lost reply retries the SAME (sender, seq)
+        — the scheduler's reply cache deduplicates it — so clients
+        ride out a scheduler outage/restart instead of crashing."""
         req.setdefault("node", self.node)
+        if req.get("op") in _MUTATING_OPS:
+            # mint the seq ONCE so every retry of this op carries it
+            with self._seq_lock:
+                self._seq += 1
+                req["sender"], req["seq"] = self._sender, self._seq
         payload = json.dumps(req) + "\n"
-        with connect_with_retry(self.addr, self.connect_deadline,
-                                self.timeout) as s:
-            f = s.makefile("rw")
-            f.write(payload)
-            f.flush()
-            line = f.readline()
-        if not line:
-            raise ConnectionResetError("empty scheduler reply")
+        budget = None
+        while True:
+            try:
+                with connect_with_retry(self.addr, self.connect_deadline,
+                                        self.timeout) as s:
+                    f = s.makefile("rw")
+                    f.write(payload)
+                    f.flush()
+                    line = f.readline()
+                if not line:
+                    raise ConnectionResetError("empty scheduler reply")
+                break
+            except (OSError, ConnectionError) as e:
+                if self.retry_deadline <= 0:
+                    raise  # legacy fail-fast (no retry window granted)
+                if budget is None:
+                    budget = _retry.RetryBudget(
+                        self.retry_deadline,
+                        op=f"sched.{req.get('op')}")
+                if budget.expired:
+                    budget.give_up(e)
+                budget.sleep()
+        if budget is not None:
+            budget.succeeded()
         resp = json.loads(line)
+        inc = resp.get("inc")
+        if inc is not None:
+            with self._seq_lock:
+                prev, self._inc = self._inc, inc
+            if prev is not None and inc != prev:
+                print(f"[sched-client] {self.node}: scheduler restarted "
+                      f"(incarnation {prev} -> {inc}); resumed from its "
+                      "journal", flush=True)
         if "error" in resp:
             raise RuntimeError(f"scheduler error: {resp['error']}")
         return resp

@@ -110,6 +110,10 @@ class WorkloadPool:
         self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.num_finished = 0
+        # Journal hook: called with the list of part ids the straggler
+        # watchdog just re-queued, OUTSIDE the pool lock (the callback
+        # may take other locks — e.g. append to the scheduler journal).
+        self.on_requeue: Optional[Callable[[list], None]] = None
 
     # -- filling ------------------------------------------------------------
     def add(self, pattern: str, num_parts_per_file: int, fmt: str = "libsvm",
@@ -182,6 +186,63 @@ class WorkloadPool:
             p.update(state=1, node=node, t_start=time.monotonic(),
                      mepoch=mepoch)
             return i, p["file"]
+
+    def assign_part(self, part_id: int, node: str,
+                    mepoch: Optional[int] = None) -> None:
+        """Re-apply a journaled assignment during scheduler replay.
+        `get` picks randomly, so replay applies the recorded choice
+        instead of re-rolling. Idempotent: a part already done (the
+        snapshot raced ahead of the journal record) is left alone."""
+        with self._lock:
+            p = self._parts[part_id]
+            if p["state"] == 2:
+                return
+            p.update(state=1, node=node, t_start=time.monotonic(),
+                     mepoch=mepoch)
+
+    def requeue_parts(self, part_ids: list) -> None:
+        """Re-apply a journaled straggler re-queue during replay: owner
+        cleared but the membership stamp KEPT, so the slow owner's late
+        finish can still land (mirrors remove_stragglers)."""
+        with self._lock:
+            for i in part_ids:
+                p = self._parts[i]
+                if p["state"] == 1:
+                    p.update(state=0, node=None)
+
+    def export_state(self) -> dict:
+        """Serializable pool state for the scheduler journal/snapshot."""
+        with self._lock:
+            return {
+                "parts": [
+                    dict(file=dataclasses.asdict(p["file"]),
+                         state=p["state"], node=p["node"],
+                         affinity=sorted(p["affinity"]), pin=p["pin"],
+                         mepoch=p["mepoch"])
+                    for p in self._parts
+                ],
+                "durations": list(self._durations),
+                "num_finished": self.num_finished,
+                "num_skipped": getattr(self, "num_skipped", 0),
+            }
+
+    def load_state(self, state: dict) -> None:
+        """Restore export_state() output. Assigned parts get a fresh
+        t_start so a long scheduler outage does not trip the straggler
+        watchdog the instant the pool comes back."""
+        now = time.monotonic()
+        with self._lock:
+            self._parts = [
+                dict(file=File(**p["file"]), state=p["state"],
+                     node=p["node"], t_start=now,
+                     affinity=set(p["affinity"]), pin=p["pin"],
+                     mepoch=p["mepoch"])
+                for p in state.get("parts", [])
+            ]
+            self._durations = [float(d) for d in state.get("durations", [])]
+            self.num_finished = int(state.get("num_finished", 0))
+            if state.get("num_skipped"):
+                self.num_skipped = int(state["num_skipped"])
 
     def finish(self, part_id: int, node: Optional[str] = None,
                mepoch: Optional[int] = None) -> bool:
@@ -287,18 +348,20 @@ class WorkloadPool:
     def remove_stragglers(self) -> int:
         """Re-queue assigned parts running > max(2 x mean, 5s); only when
         >= 10 finished samples exist (workload_pool.h:176-197)."""
+        requeued: list[int] = []
         with self._lock:
             if len(self._durations) < _STRAGGLER_MIN_SAMPLES:
                 return 0
             mean = sum(self._durations) / len(self._durations)
             limit = max(2 * mean, _STRAGGLER_FLOOR_SEC)
             now = time.monotonic()
-            n = 0
-            for p in self._parts:
+            for i, p in enumerate(self._parts):
                 if p["state"] == 1 and now - p["t_start"] > limit:
                     p.update(state=0, node=None)
-                    n += 1
-            return n
+                    requeued.append(i)
+        if requeued and self.on_requeue is not None:
+            self.on_requeue(requeued)
+        return len(requeued)
 
     def start_straggler_killer(self, interval: float = 2.0) -> None:
         if self._watchdog is not None:
