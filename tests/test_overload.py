@@ -1,0 +1,500 @@
+"""Overload protection (runtime/overload.py): deadline propagation and
+shedding, AIMD admission control, hedged fan-out, degraded-mode serving.
+
+The wire contract under test: a client's retry budget rides every frame
+as a relative `dl` header, receivers re-anchor it on their own monotonic
+clock, and an expired frame is answered with a structured shed reply
+BEFORE dispatch — without consuming the seq fence, so retries and
+hedged duplicates stay exactly-once through the reply cache.
+"""
+
+import io
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.models.linear import LinearConfig
+from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.runtime import net as _net
+from wormhole_tpu.runtime import overload as _overload
+from wormhole_tpu.runtime import retry as _retry
+from wormhole_tpu.runtime.ps_server import PSClient, ServerNode
+from wormhole_tpu.serving import LinearScorer, ModelServer, Router
+from wormhole_tpu.utils import manifest as _manifest
+
+
+def _counter(name):
+    return _obs.REGISTRY.counter(name).value()
+
+
+# ------------------------------------------------------- deadline binding
+
+def test_bind_nesting_only_tightens():
+    assert _overload.current() is None
+    assert _overload.remaining() is None
+    outer = time.monotonic() + 1.0
+    with _overload.bind(outer):
+        assert _overload.current() == outer
+        # an inner bind PAST the ambient deadline keeps the ambient one
+        with _overload.bind(outer + 100.0):
+            assert _overload.current() == outer
+        # an inner bind inside it tightens
+        with _overload.bind_in(0.1):
+            assert _overload.current() < outer
+        assert _overload.current() == outer
+    assert _overload.current() is None
+
+
+def test_bind_none_is_transparent():
+    with _overload.bind(None):
+        assert _overload.current() is None
+    with _overload.bind_in(1.0):
+        d = _overload.current()
+        with _overload.bind(None):
+            assert _overload.current() == d
+
+
+def test_wire_deadline_floors_at_zero():
+    assert _overload.wire_deadline() is None
+    with _overload.bind_in(5.0):
+        dl = _overload.wire_deadline()
+        assert 4.5 < dl <= 5.0
+    # an already-expired budget still travels (as 0) so the far end
+    # sheds it explicitly instead of it vanishing here
+    with _overload.bind(time.monotonic() - 1.0):
+        assert _overload.wire_deadline() == 0.0
+
+
+def test_frame_deadline_roundtrip():
+    """send_frame stamps the ambient budget; recv_frame re-anchors it."""
+    buf = io.BytesIO()
+    with _overload.bind_in(5.0):
+        _net.send_frame(buf, {"op": "pull"}, {})
+    buf.seek(0)
+    header, _, _ = _net.recv_frame(buf)
+    assert 4.0 < header["dl"] <= 5.0
+    anchored = _overload.header_deadline(header)
+    assert anchored is not None
+    assert 4.0 < anchored - time.monotonic() <= 5.0
+    assert not _overload.should_shed(header)
+
+
+def test_frame_without_ambient_deadline_carries_none():
+    buf = io.BytesIO()
+    _net.send_frame(buf, {"op": "pull"}, {})
+    buf.seek(0)
+    header, _, _ = _net.recv_frame(buf)
+    assert "dl" not in header
+    assert _overload.header_deadline(header) is None
+    assert not _overload.should_shed(header)
+
+
+def test_should_shed_rules(monkeypatch):
+    expired = {"op": "pull", "dl_mono": time.monotonic() - 0.01}
+    sheds0 = _counter("net.deadline.shed")
+    assert _overload.should_shed(dict(expired))
+    assert _counter("net.deadline.shed") == sheds0 + 1
+    # control ops are never shed, no matter how stale
+    assert not _overload.should_shed(dict(expired, op="hello"))
+    assert not _overload.should_shed(dict(expired, op="shutdown"))
+    # the kill switch turns receiver-side shedding off entirely
+    monkeypatch.setenv("WH_DEADLINE_SHED", "0")
+    assert not _overload.should_shed(dict(expired))
+
+
+def test_shed_reply_shape():
+    r = _overload.shed_reply({"op": "fetch"})
+    assert r["shed"] == 1
+    assert "deadline expired" in r["error"] and "fetch" in r["error"]
+
+
+# ---------------------------------------------------- admission control
+
+def test_admission_fixed_mode_matches_inflight_gate():
+    gate = _overload.AdmissionController(limit=2, adaptive=False)
+    assert gate.try_enter("pull") and gate.try_enter("pull")
+    assert not gate.try_enter("pull")          # full: bounced
+    assert gate.try_enter("hello")             # control bypasses
+    gate.leave("hello")
+    gate.leave("pull", 0.001)
+    assert gate.try_enter("pull")              # freed slot re-admits
+    # limit=0 admits everything (the historical "off" contract)
+    off = _overload.AdmissionController(limit=0, adaptive=False)
+    assert all(off.try_enter("pull") for _ in range(100))
+
+
+def test_admission_aimd_decays_and_regrows(monkeypatch):
+    monkeypatch.setenv("WH_ADMIT_MIN", "2")
+    monkeypatch.setenv("WH_ADMIT_MAX", "64")
+    monkeypatch.setenv("WH_ADMIT_LATENCY_MS", "50")
+    monkeypatch.setenv("WH_ADMIT_BACKOFF", "0.5")
+    gate = _overload.AdmissionController(limit=16, adaptive=True)
+    assert gate.limit == 16
+
+    def window(latency_s):
+        for _ in range(gate._ADJUST_EVERY):
+            assert gate.try_enter("pull")
+            gate.leave("pull", latency_s)
+
+    window(0.200)                 # EWMA far over the 50ms target
+    assert gate.limit == 8        # multiplied down by 0.5
+    window(0.200)
+    assert gate.limit == 4
+    window(0.200)
+    window(0.200)
+    assert gate.limit == 2        # floored at WH_ADMIT_MIN
+
+    # growth needs a clean window that actually ran AT the limit
+    for _ in range(40):           # walk the EWMA back under target
+        assert gate.try_enter("pull")
+        gate.leave("pull", 0.001)
+    limit0 = gate.limit
+    holders = [gate.try_enter("pull") for _ in range(limit0)]
+    assert all(holders)
+    assert not gate.try_enter("pull")   # hit the limit
+    for _ in range(limit0):
+        gate.leave("pull", 0.001)
+    for _ in range(gate._ADJUST_EVERY):
+        assert gate.try_enter("pull")
+        gate.leave("pull", 0.001)
+    assert gate.limit == limit0 + 1     # additive increase
+
+
+def test_busy_hint_scales_with_reject_pressure():
+    gate = _overload.AdmissionController(limit=1, adaptive=False)
+    assert gate.try_enter("pull")
+    base = gate.busy_hint_ms()
+    for _ in range(5):
+        assert not gate.try_enter("pull")
+    assert gate.busy_hint_ms() > base
+    for _ in range(10_000):
+        gate.try_enter("pull")
+    assert gate.busy_hint_ms() <= 250.0   # capped
+
+
+# --------------------------------------------------------------- hedging
+
+def test_hedge_tracker_warmup_quantile_and_budget():
+    t = _overload.HedgeTracker(quantile=0.9, budget_pct=5.0,
+                               min_ms=1.0, warmup=8)
+    assert t.delay_s() is None            # cold: never hedge
+    for ms in range(1, 101):              # 1..100ms primaries
+        t.observe(ms / 1e3)
+    d = t.delay_s()
+    assert 0.085 <= d <= 0.095            # ~p90 of the window
+    # 5% of 100 primaries = 5 hedges, the 6th is suppressed
+    sup0 = _counter("serve.hedge.suppressed")
+    assert [t.try_issue() for _ in range(6)] == [True] * 5 + [False]
+    assert _counter("serve.hedge.suppressed") == sup0 + 1
+
+
+def test_hedge_tracker_floors_delay():
+    t = _overload.HedgeTracker(quantile=0.95, budget_pct=5.0,
+                               min_ms=25.0, warmup=4)
+    for _ in range(8):
+        t.observe(0.0001)                 # sub-ms primaries
+    assert t.delay_s() == pytest.approx(0.025)
+
+
+def test_hedge_duplicate_seq_is_exactly_once(tmp_path):
+    """The hedge contract at the shard: the SAME (sender, seq) fetch
+    arriving on a DIFFERENT connection is answered from the per-sender
+    reply cache with the original bytes — never re-dispatched."""
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
+    base = str(tmp_path / "srv")
+    _manifest.write_snapshot_set(
+        base, {"w": np.arange(cfg.num_buckets, dtype=np.float32)},
+        world=1)
+    server = ModelServer(0, 1, base)
+    server.serve()
+    try:
+        host, port = server.uri.rsplit(":", 1)
+        keys = np.arange(6, dtype=np.int64)
+        hdr = {"op": "fetch", "tables": ["w"], "sender": "hedger",
+               "seq": 3}
+        socks, replies, arrays = [], [], []
+        dedup0 = _counter("serve.dedup_hits")
+        for _ in range(2):                # primary, then the hedge
+            s = _net.connect_with_retry((host, int(port)), 5.0)
+            socks.append(s)
+            f = s.makefile("rwb")
+            _net.send_frame(f, hdr, {"k:w": keys})
+            h, a, _ = _net.recv_frame(f)
+            replies.append(h)
+            arrays.append(a)
+        assert replies[0]["version"] == replies[1]["version"]
+        assert np.array_equal(arrays[0]["r:w"], arrays[1]["r:w"])
+        assert _counter("serve.dedup_hits") == dedup0 + 1
+    finally:
+        for s in socks:
+            s.close()
+        server.stop()
+
+
+class _StubHedge:
+    """A hedge tracker pinned open: tiny delay, unlimited budget."""
+
+    def __init__(self, delay=0.05):
+        self.delay = delay
+        self.issued = 0
+        self.wins = 0
+        self.observed = []
+
+    def delay_s(self):
+        return self.delay
+
+    def try_issue(self):
+        self.issued += 1
+        return True
+
+    def observe(self, latency_s):
+        self.observed.append(latency_s)
+
+    def won(self):
+        self.wins += 1
+
+
+class _StallFirstFetchGate:
+    """Admission gate that stalls the FIRST fetch inside the handler —
+    the deterministic straggler a hedge exists to cut past."""
+
+    def __init__(self, stall_s):
+        self.stall_s = stall_s
+        self._lock = threading.Lock()
+        self._stalled = False
+
+    def try_enter(self, op=None):
+        if op == "fetch":
+            with self._lock:
+                first = not self._stalled
+                self._stalled = True
+            if first:
+                time.sleep(self.stall_s)
+        return True
+
+    def leave(self, op=None, service_s=0.0):
+        pass
+
+    def busy_hint_ms(self, base_ms=25.0):
+        return base_ms
+
+
+def test_router_hedge_wins_over_stalled_shard(tmp_path):
+    """End-to-end hedge: the primary fetch stalls in the shard, the
+    backup (same sender+seq, fresh connection) answers first, and the
+    router returns the correct scores with a hedge win recorded."""
+    rng = np.random.default_rng(11)
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
+    base = str(tmp_path / "srv")
+    v1 = _manifest.write_snapshot_set(
+        base, {"w": rng.normal(size=cfg.num_buckets)
+               .astype(np.float32)}, world=1)
+    server = ModelServer(0, 1, base)
+    server.serve()
+    router = Router([server.uri], LinearScorer(cfg))
+    try:
+        from tests.test_serving import _blk
+        blk = _blk(rng, n=16)
+        expected, ver = router.predict_block(blk)   # un-hedged warmup
+        assert ver == v1
+        router._hedge = _StubHedge(delay=0.05)
+        server._gate = _StallFirstFetchGate(stall_s=1.0)
+        t0 = time.perf_counter()
+        scores, ver2 = router.predict_block(blk)
+        took = time.perf_counter() - t0
+        assert ver2 == v1
+        np.testing.assert_array_equal(scores, expected)
+        assert router._hedge.issued >= 1
+        assert router._hedge.wins == 1   # stub intercepts won()
+        assert took < 0.9   # did NOT wait out the 1s stall
+    finally:
+        router.close()
+        server.stop()
+
+
+def test_shed_is_a_timeout_error():
+    # every caller that already classifies deadline misses must catch
+    # an overload bounce without new plumbing
+    assert issubclass(_overload.Shed, TimeoutError)
+
+
+def test_router_gate_armed_only_by_aimd_knob(monkeypatch):
+    assert _overload.router_gate() is None
+    monkeypatch.setenv("WH_ADMIT_AIMD", "1")
+    gate = _overload.router_gate()
+    assert gate is not None and gate.adaptive and gate.enabled
+
+
+def test_router_bounces_at_entry_when_saturated(tmp_path):
+    """Client-edge admission: a saturated router sheds predicts at
+    ENTRY (fail-fast Shed) instead of queueing them to expiry, and an
+    already-expired budget is shed before any fan-out."""
+    rng = np.random.default_rng(7)
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 9, nnz_per_row=4)
+    base = str(tmp_path / "srv")
+    _manifest.write_snapshot_set(
+        base, {"w": np.ones(cfg.num_buckets, np.float32)}, world=1)
+    server = ModelServer(0, 1, base)
+    server.serve()
+    router = Router([server.uri], LinearScorer(cfg))
+    try:
+        from tests.test_serving import _blk
+        blk = _blk(rng, n=8)
+        router.predict_block(blk)          # sanity: ungated works
+        gate = _overload.AdmissionController(limit=1, adaptive=False)
+        assert gate.try_enter("predict")   # occupy the only slot
+        router._gate = gate
+        with pytest.raises(_overload.Shed, match="saturated"):
+            router.predict_block(blk)
+        gate.leave("predict", 0.001)
+        router.predict_block(blk)          # freed slot admits again
+        router._gate = None
+        sheds0 = _counter("serve.shed.deadline")
+        with _overload.bind(time.monotonic() - 0.01):
+            with pytest.raises(_overload.Shed, match="deadline expired"):
+                router.predict_block(blk)
+        assert _counter("serve.shed.deadline") == sheds0 + 1
+    finally:
+        router.close()
+        server.stop()
+
+
+# ------------------------------------------------- shedding at receivers
+
+def test_ps_shard_sheds_expired_pull_then_recovers():
+    node = ServerNode(0, 1)
+    node.serve()
+    client = PSClient([node.uri])
+    try:
+        w = np.arange(8, dtype=np.float32)
+        client.init({"w": w})
+        with _overload.bind(time.monotonic() - 0.01):
+            with pytest.raises(RuntimeError, match="deadline expired"):
+                client.pull()
+        # nothing was consumed by the shed: the next budget-less pull
+        # dispatches normally and sees the full state
+        np.testing.assert_array_equal(client.pull()["w"], w)
+    finally:
+        client.close()
+        node.stop()
+
+
+def test_ps_control_ops_never_shed_under_expired_deadline():
+    node = ServerNode(0, 1)
+    node.serve()
+    client = PSClient([node.uri])
+    try:
+        client.init({"w": np.ones(4, np.float32)})
+        with _overload.bind(time.monotonic() - 0.01):
+            # stats is control-plane: it must answer, not shed
+            assert client.stats() is not None
+    finally:
+        client.close()
+        node.stop()
+
+
+def test_serving_shard_sheds_expired_fetch(tmp_path):
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 9, nnz_per_row=4)
+    base = str(tmp_path / "srv")
+    _manifest.write_snapshot_set(
+        base, {"w": np.ones(cfg.num_buckets, np.float32)}, world=1)
+    server = ModelServer(0, 1, base)
+    server.serve()
+    try:
+        host, port = server.uri.rsplit(":", 1)
+        sock = _net.connect_with_retry((host, int(port)), 5.0)
+        f = sock.makefile("rwb")
+        hdr = {"op": "fetch", "tables": ["w"], "sender": "t", "seq": 1}
+        sheds0 = _counter("serve.shed.deadline")
+        with _overload.bind(time.monotonic() - 0.01):
+            _net.send_frame(f, hdr, {"k:w": np.arange(3)})
+        h, _, _ = _net.recv_frame(f)
+        assert h.get("shed") == 1 and "deadline expired" in h["error"]
+        assert "version" in h     # shed replies still identify the model
+        assert _counter("serve.shed.deadline") == sheds0 + 1
+        # the fence was not consumed: the SAME seq under a live budget
+        # dispatches for real
+        _net.send_frame(f, hdr, {"k:w": np.arange(3)})
+        h2, a2, _ = _net.recv_frame(f)
+        assert "error" not in h2
+        np.testing.assert_array_equal(a2["r:w"], np.ones(3, np.float32))
+        sock.close()
+    finally:
+        server.stop()
+
+
+def test_scheduler_sheds_only_metrics():
+    from wormhole_tpu.runtime.tracker import Scheduler, SchedulerClient
+
+    sched = Scheduler(num_workers=0, num_servers=0, straggler=False)
+    sched.serve()
+    client = SchedulerClient(sched.uri, "overload-test")
+    try:
+        with _overload.bind(time.monotonic() - 0.01):
+            with pytest.raises(RuntimeError, match="deadline expired"):
+                client.call(op="metrics")
+            # every other scheduler verb IS the control plane
+            resp = client.call(op="serve_nodes")
+            assert "error" not in resp
+        assert "error" not in client.call(op="metrics")
+    finally:
+        sched.stop()
+
+
+# ------------------------------------------- budget-aware retries/dials
+
+def test_connect_clamped_by_ambient_deadline():
+    # a port with nothing listening: refused instantly, retried until
+    # the AMBIENT budget (not the 30s default) gives up
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with _overload.bind_in(0.3):
+        with pytest.raises(OSError):
+            _net.connect_with_retry(("127.0.0.1", port), deadline_s=30.0)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_jitter_sleep_capped_by_ambient_budget():
+    with _overload.bind_in(0.05):
+        t0 = time.monotonic()
+        slept = _retry.jitter_sleep(10.0)    # a 10s hint
+        assert time.monotonic() - t0 < 1.0
+        assert slept <= 0.06
+
+
+# -------------------------------------------------------- degraded mode
+
+def test_degrade_controller_arms_and_clears(monkeypatch):
+    monkeypatch.setenv("WH_DEGRADE", "1")
+    monkeypatch.setenv("WH_DEGRADE_BURN", "5.0")
+    monkeypatch.setenv("WH_DEGRADE_AFTER_SEC", "0.05")
+    monkeypatch.setenv("WH_DEGRADE_CLEAR_SEC", "0.05")
+    d = _overload.DegradeController(target_ms=10.0, window=20)
+    assert not d.active()
+    enters0 = _counter("serve.degraded.enters")
+    d.observe(1.0)                 # 1000ms >> 10ms target
+    assert not d.active()          # burn must SUSTAIN, not spike
+    time.sleep(0.06)
+    d.observe_replay()             # replays count as violations
+    assert d.active()
+    assert _counter("serve.degraded.enters") == enters0 + 1
+    # recovery: fast requests dilute the window below the burn bar
+    for _ in range(40):
+        d.observe(0.001)
+    time.sleep(0.06)
+    d.observe(0.001)
+    assert not d.active()
+
+
+def test_degrade_controller_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("WH_DEGRADE", "0")
+    d = _overload.DegradeController(target_ms=1.0, window=4)
+    for _ in range(10):
+        d.observe(1.0)
+    assert not d.active()

@@ -268,14 +268,17 @@ def test_busy_bounce_is_retried_and_exactly_once(tmp_path):
         def __init__(self, bounces):
             self.bounces = bounces
 
-        def try_enter(self):
+        def try_enter(self, op=None):
             if self.bounces > 0:
                 self.bounces -= 1
                 return False
             return True
 
-        def leave(self):
+        def leave(self, op=None, service_s=0.0):
             pass
+
+        def busy_hint_ms(self, base_ms=25.0):
+            return 1.0  # keep the test's bounce retries fast
 
     # install the bouncy gate AFTER the Router's constructor hello so
     # the bounces land on the measured predict fetch

@@ -163,17 +163,41 @@ _BSP_METRIC_KEYS = ("bsp_recoveries", "bsp_ring_retries",
                     "bsp_result_fetches", "bsp_rounds",
                     "bsp_checkpoints", "connect_retries")
 
-# --elastic matrix: (name, WH_ELASTIC_PLAN, fault spec, serve drill).
-# Plan offsets are seconds from scheduler start; the 6-pass 2-worker
-# job runs ~20s, so join@4 lands mid-pass-1 and leave@13 mid-run with
-# passes still to go — the re-pinned parts and the shrunk set both
-# have to produce real work after the epoch bump.
+# --elastic matrix: (name, WH_ELASTIC_PLAN, fault spec, serve mode,
+# extra env). Plan offsets are seconds from scheduler start; the 6-pass
+# 2-worker job runs ~20s, so join@4 lands mid-pass-1 and leave@13
+# mid-run with passes still to go — the re-pinned parts and the shrunk
+# set both have to produce real work after the epoch bump.
+#
+# Serve modes drive the router thread in THIS process: "" = no driver,
+# "steady" = closed-loop predicts every 250ms (bar: zero failures),
+# "overload" = a hot multi-thread hammer with a per-request deadline
+# (bar: deadline sheds are EXPECTED, hard failures and hangs are not,
+# and goodput stays nonzero — no congestion collapse). The extra env
+# lands in both the job subprocesses and this process for the
+# scenario's duration, so driver-side knobs (WH_HEDGE) and shard-side
+# knobs (WH_ADMIT_AIMD) both take effect.
+#
+# slow-shard+hedge: net:slow@fetch fires at the serve shard's dispatch
+# hook (serving/server.py), turning every fetch into a 60ms straggler;
+# the hedged router must still see zero failed predicts. overload+shed:
+# 40ms fetches + an 8-thread hot driver against the AIMD gate — the
+# shard sheds what it can't serve inside the deadline and the training
+# job must converge untouched.
 ELASTIC_SCENARIOS = [
-    ("join@4s", "join@4", "", False),
-    ("leave@4s", "leave@4", "", False),
-    ("churn+serve", "join@4,leave@13", "", True),
-    ("partition-heal", "", "net:partition@push:5", False),
-    ("slow-link", "", "net:slow@pull:10", False),
+    ("join@4s", "join@4", "", "", None),
+    ("leave@4s", "leave@4", "", "", None),
+    ("churn+serve", "join@4,leave@13", "", "steady", None),
+    ("partition-heal", "", "net:partition@push:5", "", None),
+    ("slow-link", "", "net:slow@pull:10", "", None),
+    ("slow-shard+hedge", "", "net:slow@fetch:60", "steady",
+     {"WH_HEDGE": "1"}),
+    # 40ms fetches against a 20ms AIMD latency target: the gate decays
+    # to WH_ADMIT_MIN and the 8 hammer threads overrun it, so bounces
+    # and deadline sheds are guaranteed, not timing luck
+    ("overload+shed", "", "net:slow@fetch:40", "overload",
+     {"WH_ADMIT_AIMD": "1", "WH_ADMIT_LATENCY_MS": "20",
+      "WH_HEDGE": "1", "WH_DEADLINE_SHED": "1"}),
 ]
 
 _ELASTIC_METRIC_KEYS = ("membership_epochs", "worker_joins",
@@ -462,15 +486,34 @@ def _predict_block(rng, rows: int, nnz: int):
     )
 
 
+def _is_shed(e: Exception) -> bool:
+    """Deadline sheds and busy bounces are the overload machinery WORKING
+    — the shard refused work nobody would wait for. Anything else that
+    escapes the router is a hard failure."""
+    msg = str(e).lower()
+    return ("deadline" in msg or "shed" in msg or "busy" in msg
+            or isinstance(e, TimeoutError))
+
+
 def _serve_driver(sched_uri: str, stop, stats: dict,
-                  retry_deadline: float | None = None) -> None:
-    """Closed-loop predict load against the job's --serve tier for the
-    whole churn window. The acceptance bar is ZERO failed requests:
-    worker joins/leaves, snapshot swaps, and part re-pins must never be
-    visible to the serving path. `retry_deadline` budgets the driver's
-    scheduler RPCs so shard re-resolution rides out a scheduler restart
-    (the --sched drill sets it; the default keeps fail-fast)."""
+                  retry_deadline: float | None = None,
+                  mode: str = "steady") -> None:
+    """Predict load against the job's --serve tier for the whole churn
+    window. mode="steady" is closed-loop at a gentle cadence and the
+    acceptance bar is ZERO failed requests: worker joins/leaves,
+    snapshot swaps, part re-pins — and, with WH_HEDGE on, a slow
+    shard — must never be visible to the serving path.
+
+    mode="overload" hammers the tier from 8 hot threads, each request
+    under a 350ms propagated deadline: sheds are expected and counted
+    separately; hard failures and hangs are not. `retry_deadline`
+    budgets the driver's scheduler RPCs so shard re-resolution rides
+    out a scheduler restart (the --sched drill sets it; the default
+    keeps fail-fast)."""
+    import threading
+
     from wormhole_tpu.models.difacto import DifactoConfig
+    from wormhole_tpu.runtime import overload as _overload
     from wormhole_tpu.runtime.tracker import SchedulerClient
     from wormhole_tpu.serving import DifactoScorer, Router
 
@@ -486,26 +529,87 @@ def _serve_driver(sched_uri: str, stop, stats: dict,
     except Exception as e:  # the verdict reports it; don't kill the lab
         stats["error"] = f"router never came up: {e}"
         return
-    try:
-        while not stop.wait(0.25):
-            try:
-                router.predict_block(blocks[stats["requests"]
-                                            % len(blocks)])
+    lock = threading.Lock()
+
+    def one(i: int, deadline_s: float = 0.0) -> bool:
+        """Returns True when the request was shed (caller may back off)."""
+        try:
+            if deadline_s > 0:
+                with _overload.bind_in(deadline_s):
+                    router.predict_block(blocks[i % len(blocks)])
+            else:
+                router.predict_block(blocks[i % len(blocks)])
+            with lock:
                 stats["requests"] += 1
-            except Exception as e:
-                stats["failures"] += 1
-                stats.setdefault("error", str(e))
+        except Exception as e:
+            if deadline_s > 0 and _is_shed(e):
+                with lock:
+                    stats["sheds"] += 1
+                return True
+            elif not stop.is_set():
+                # errors after stop are teardown noise: the job exited
+                # and took its serve shards with it mid-request
+                with lock:
+                    stats["failures"] += 1
+                    stats.setdefault("error", str(e))
+
+    try:
+        if mode == "overload":
+            def hammer(tid: int) -> None:
+                i = tid
+                while not stop.is_set():
+                    if one(i, deadline_s=0.35):
+                        # fail-fast bounces return in microseconds; without
+                        # a pause the hammer busy-spins millions of sheds
+                        stop.wait(0.005)
+                    i += 1
+
+            hammers = [threading.Thread(target=hammer, args=(t,),
+                                        daemon=True) for t in range(8)]
+            for t in hammers:
+                t.start()
+            for t in hammers:
+                t.join()
+        else:
+            i = 0
+            while not stop.wait(0.25):
+                one(i)
+                i += 1
     finally:
+        if router._hedge is not None:
+            stats["hedges"] = router._hedge._issued
         router.close()
 
 
 def run_elastic_job(conf: str, plan: str, spec: str, workers: int,
                     servers: int, timeout: float, obs_dir: str,
-                    serve: bool = False
+                    mode: str = "", extra_env: dict | None = None
                     ) -> tuple[int, str, float, dict | None, dict]:
-    """One `--elastic` launcher run; with serve=True the scheduler port
-    is pinned (WH_SCHED_PORT) and a router driver thread fires predict
-    batches at the --serve tier for the duration."""
+    """One `--elastic` launcher run; with a serve mode the scheduler
+    port is pinned (WH_SCHED_PORT) and a router driver thread fires
+    predict batches at the --serve tier for the duration ("steady" =
+    gentle closed loop, "overload" = deadline-bounded hot hammer).
+    `extra_env` is applied to os.environ for the scenario — the job
+    subprocesses inherit it AND the in-process driver's knob reads see
+    it (WH_HEDGE arms the router's hedge tracker at construction)."""
+    serve = bool(mode)
+    saved = {k: os.environ.get(k) for k in (extra_env or {})}
+    os.environ.update(extra_env or {})
+    try:
+        return _run_elastic_job(conf, plan, spec, workers, servers,
+                                timeout, obs_dir, serve, mode)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _run_elastic_job(conf: str, plan: str, spec: str, workers: int,
+                     servers: int, timeout: float, obs_dir: str,
+                     serve: bool, mode: str
+                     ) -> tuple[int, str, float, dict | None, dict]:
     import threading
 
     env = dict(os.environ, PYTHONPATH=REPO)
@@ -528,7 +632,7 @@ def run_elastic_job(conf: str, plan: str, spec: str, workers: int,
     argv = [sys.executable, "-m", "wormhole_tpu.launcher.dmlc_tpu",
             "-n", str(workers), "-s", str(servers),
             "--node-timeout", "10", "--elastic"]
-    stats = {"requests": 0, "failures": 0}
+    stats = {"requests": 0, "failures": 0, "sheds": 0}
     port = None
     if serve:
         port = _free_port()
@@ -544,7 +648,8 @@ def run_elastic_job(conf: str, plan: str, spec: str, workers: int,
     driver = None
     if serve:
         driver = threading.Thread(
-            target=_serve_driver, args=(f"127.0.0.1:{port}", stop, stats),
+            target=_serve_driver,
+            args=(f"127.0.0.1:{port}", stop, stats, None, mode),
             daemon=True)
         driver.start()
     try:
@@ -613,10 +718,13 @@ max_delay = 1
     print(f"[chaos] baseline: logloss={base:.5f} ({dt:.0f}s)")
 
     rows, worst = [], 0
-    for i, (name, plan, spec, serve) in enumerate(ELASTIC_SCENARIOS):
+    for i, (name, plan, spec, mode, extra_env) in \
+            enumerate(ELASTIC_SCENARIOS):
+        serve = bool(mode)
         rc, out, dt, report, stats = run_elastic_job(
             conf, plan, spec, workers, args.servers, args.timeout,
-            os.path.join(scratch, f"obs-{i}"), serve=serve)
+            os.path.join(scratch, f"obs-{i}"), mode=mode,
+            extra_env=extra_env)
         ll = final_logloss(out)
         m = report_metrics(report, _ELASTIC_METRIC_KEYS)
         if rc != 0 or ll is None:
@@ -655,17 +763,34 @@ max_delay = 1
                 if stats.get("error") and stats["requests"] == 0:
                     problems.append(stats["error"])
                 elif stats["requests"] < 1:
-                    problems.append("serve driver issued no requests")
+                    # under overload this is the congestion-collapse
+                    # signature: offered load starved goodput to zero
+                    problems.append(
+                        "no goodput (congestion collapse)"
+                        if mode == "overload"
+                        else "serve driver issued no requests")
                 elif stats["failures"] > 0:
                     problems.append(
                         f"{stats['failures']} failed serve requests")
+                if mode == "overload" and stats.get("sheds", 0) < 1:
+                    # 8 hot threads vs 40ms fetches and a decayed AIMD
+                    # gate MUST bounce something; a shed-free run means
+                    # the drill never pressed the tier and proves
+                    # nothing about collapse
+                    problems.append("overload never bit (no sheds)")
             if problems:
                 verdict = f"survived ({'; '.join(problems)}!)"
                 worst = max(worst, 1)
         deltas = metric_deltas(m, base_m, _ELASTIC_METRIC_KEYS) \
             if report is not None else "(no run_report.json)"
-        serve_note = (f", serve {stats['requests']} ok /"
-                      f" {stats['failures']} failed" if serve else "")
+        serve_note = ""
+        if serve:
+            serve_note = (f", serve {stats['requests']} ok /"
+                          f" {stats['failures']} failed")
+            if mode == "overload":
+                serve_note += f" / {stats.get('sheds', 0)} shed"
+            if stats.get("hedges"):
+                serve_note += f", {stats['hedges']} hedged"
         rows.append((name, verdict, detail, dt, deltas))
         print(f"[chaos] {name}: {verdict} ({detail.splitlines()[0]}"
               f"{serve_note}, {dt:.0f}s)")

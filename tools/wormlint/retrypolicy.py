@@ -14,10 +14,18 @@ metrics, so this checker flags the two patterns that bypass it:
   ``time.sleep`` — backoff belongs in ``RetryBudget.sleep()``. Handlers
   that provably exit (``return`` / ``raise`` / ``break``) don't count:
   that's error reporting, not a retry.
+- a client RPC path with no deadline threading: a function that calls
+  ``send_frame`` before ``recv_frame`` (line order — servers recv
+  first, so handlers don't match) is a request/reply client, and every
+  such path must ride under some budget so the frame carries a wire
+  deadline the far end can shed on (``runtime/overload.py``). The
+  check is lexical: the function — or, for helper methods, its
+  enclosing class — must reference the deadline machinery somewhere
+  (``RetryBudget`` / ``budget`` / ``deadline`` / ``overload`` / ...).
 
 ``runtime/retry.py`` itself is exempt (it *is* the policy), and a
-``# wormlint: disable=retry-policy`` directive on the dial or the
-``while`` line suppresses either pattern.
+``# wormlint: disable=retry-policy`` directive on the dial, the
+``while`` line, or the ``send_frame`` line suppresses any pattern.
 """
 
 from __future__ import annotations
@@ -90,6 +98,65 @@ def _loop_rolls_retry(loop: ast.While) -> Optional[int]:
     return sleep_line if (catches and sleep_line is not None) else None
 
 
+# identifiers whose presence marks a function (or its class) as
+# threaded through the deadline machinery: a RetryBudget (mints the
+# deadline), an ambient bind/rebind, or an explicit wire/header
+# deadline. Deliberately NOT bare "bind" — trace-context bind alone
+# does not budget anything.
+_DEADLINE_IDS = {"RetryBudget", "budget", "busy_budget", "deadline",
+                 "retry_deadline", "overload", "_overload", "dl",
+                 "dl_mono", "bind_in", "wire_deadline",
+                 "header_deadline", "remaining"}
+
+
+def _identifiers(node: ast.AST) -> set[str]:
+    ids: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            ids.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            ids.add(n.attr)
+        elif isinstance(n, ast.arg):
+            ids.add(n.arg)
+    return ids
+
+
+def _own_nodes(fn: ast.AST):
+    """The nodes lexically inside `fn` but not inside a nested def —
+    a closure that sends frames is judged as its own client path."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _client_rpc_send(fn: ast.AST) -> Optional[int]:
+    """Line of the first ``send_frame`` if `fn` sends a request frame
+    and later (by line) receives a reply — the client RPC shape."""
+    sends, recvs = [], []
+    for n in _own_nodes(fn):
+        if isinstance(n, ast.Call):
+            t = terminal_name(n.func)
+            if t == "send_frame":
+                sends.append(n.lineno)
+            elif t == "recv_frame":
+                recvs.append(n.lineno)
+    if sends and recvs and min(sends) < max(recvs):
+        return min(sends)
+    return None
+
+
+def _enclosing_class(parents: dict, node: ast.AST) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
 def check(files: list[FileSource]) -> list[Finding]:
     findings: list[Finding] = []
     for src in files:
@@ -122,4 +189,22 @@ def check(files: list[FileSource]) -> list[Finding]:
                              f"{sleep_line}) — use "
                              f"runtime.retry.RetryBudget for backoff, "
                              f"deadline and give-up accounting")))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                send_line = _client_rpc_send(node)
+                if send_line is None:
+                    continue
+                if _identifiers(node) & _DEADLINE_IDS:
+                    continue
+                cls = _enclosing_class(parents, node)
+                if cls is not None and _identifiers(cls) & _DEADLINE_IDS:
+                    continue
+                findings.append(Finding(
+                    CHECKER, src.path, send_line,
+                    key=f"rpc:{node.name}",
+                    message=(f"client RPC path '{node.name}' sends a "
+                             "request frame with no deadline threading "
+                             "in reach — mint a RetryBudget (or bind an "
+                             "ambient deadline via runtime.overload) so "
+                             "the frame carries a wire deadline the "
+                             "receiver can shed on")))
     return findings

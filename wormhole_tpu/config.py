@@ -332,6 +332,27 @@ declare_knob("WH_NET_MAX_INFLIGHT", int, 0,
              "concurrently; overflow gets a structured `busy` reply the "
              "client backs off on and retries (0 = unlimited).",
              group="ps")
+declare_knob("WH_DEADLINE_SHED", bool, True,
+             "Shed frames whose propagated deadline expired before dispatch "
+             "(the `dl` header field); off = deadlines still ride the wire "
+             "but every frame is dispatched.", group="ps")
+declare_knob("WH_ADMIT_AIMD", bool, False,
+             "Adaptive (AIMD) admission control on frame servers: the "
+             "in-flight limit walks between WH_ADMIT_MIN and WH_ADMIT_MAX "
+             "driven by measured handler latency and SLO burn, instead of "
+             "the fixed WH_NET_MAX_INFLIGHT bound.", group="ps")
+declare_knob("WH_ADMIT_MIN", int, 4,
+             "Floor of the AIMD admission limit.", group="ps")
+declare_knob("WH_ADMIT_MAX", int, 256,
+             "Ceiling of the AIMD admission limit (also the adaptive "
+             "starting limit when WH_NET_MAX_INFLIGHT is 0).", group="ps")
+declare_knob("WH_ADMIT_LATENCY_MS", float, 50.0,
+             "Service-latency target of the AIMD controller: a completion "
+             "window whose EWMA handler latency exceeds this multiplies "
+             "the limit by WH_ADMIT_BACKOFF.", group="ps")
+declare_knob("WH_ADMIT_BACKOFF", float, 0.7,
+             "Multiplicative-decrease factor of the AIMD admission "
+             "controller.", group="ps")
 
 # online serving tier (wormhole_tpu/serving/)
 declare_knob("WH_NUM_SERVE", int, 0,
@@ -349,6 +370,39 @@ declare_knob("WH_SERVE_RETRY_SEC", float, 30.0,
              "Router-side retry window for a dead serving shard: how long "
              "predict fan-outs re-resolve and redial before a batch fails.",
              group="serve")
+declare_knob("WH_DEADLINE_MS", float, 0.0,
+             "Per-request deadline the router binds around each predict "
+             "batch, propagated to shards in frame headers; expired work "
+             "is shed instead of computed (0 = no implicit deadline).",
+             group="serve")
+declare_knob("WH_HEDGE", bool, False,
+             "Hedged fan-out: a shard RPC still unanswered after the "
+             "rolling WH_HEDGE_QUANTILE latency gets ONE backup request "
+             "on a fresh connection; the shard reply cache keeps the "
+             "duplicate exactly-once.", group="serve")
+declare_knob("WH_HEDGE_QUANTILE", float, 0.95,
+             "Latency quantile of recent primary RPCs after which a hedge "
+             "fires.", group="serve")
+declare_knob("WH_HEDGE_BUDGET_PCT", float, 5.0,
+             "Hedge budget: backups may add at most this percent to the "
+             "primary RPC count.", group="serve")
+declare_knob("WH_HEDGE_MIN_MS", float, 5.0,
+             "Floor of the hedge delay, so a fast window cannot hedge "
+             "aggressively enough to double load.", group="serve")
+declare_knob("WH_DEGRADE", bool, True,
+             "Degraded-mode serving: under sustained SLO burn the router "
+             "stops the mixed-version fan-out replay and serves bounded-"
+             "staleness replies stamped degraded=1, recovering when burn "
+             "clears.", group="serve")
+declare_knob("WH_DEGRADE_BURN", float, 5.0,
+             "Burn-rate threshold (violating fraction over the SLO "
+             "allowance) that arms degraded mode.", group="serve")
+declare_knob("WH_DEGRADE_AFTER_SEC", float, 2.0,
+             "Seconds the burn must stay above WH_DEGRADE_BURN before "
+             "degraded mode activates.", group="serve")
+declare_knob("WH_DEGRADE_CLEAR_SEC", float, 5.0,
+             "Seconds the burn must stay clear before degraded mode "
+             "deactivates.", group="serve")
 
 # BSP allreduce plane (runtime/allreduce.py)
 declare_knob("WH_BSP_STEP_TIMEOUT", float, 2.0,

@@ -61,6 +61,16 @@ COUNTERS: dict[str, str] = {
     "sched.serve_recoveries": "serving shards that re-registered after death",
     "net.busy.rejections": "frames bounced by the max-in-flight gate",
     "net.busy.retries": "client resends after a busy reply",
+    "net.deadline.shed": "frames shed because their deadline expired in transit",
+    "admit.sheds": "bulk requests bounced by the admission controller",
+    "serve.shed.deadline": "serving requests shed for an expired deadline",
+    "serve.shed.busy": "serving requests bounced busy by the admission gate",
+    "serve.hedge.issued": "backup fan-out RPCs issued to slow shards",
+    "serve.hedge.wins": "fan-out legs where the hedge answered first",
+    "serve.hedge.suppressed": "hedge firings denied by the hedge budget",
+    "serve.degraded.replies": "predict replies served in degraded mode",
+    "serve.degraded.enters": "transitions into degraded-mode serving",
+    "serve.degraded.exits": "recoveries out of degraded-mode serving",
     "net.frames_sent": "frames written to sockets",
     "net.frames_recv": "frames read from sockets",
     "net.bytes_sent": "bytes written to sockets",
@@ -100,6 +110,10 @@ GAUGES: dict[str, str] = {
     "obs.ring.depth": "snapshots held by the scheduler's telemetry ring",
     "sched.incarnation": "scheduler incarnation number (0 = never restarted)",
     "slo.*_burn": "error-budget burn rate per declared SLO (>1 = violated)",
+    "admit.limit": "current AIMD concurrency limit of the admission gate",
+    "admit.inflight": "bulk requests currently admitted into handlers",
+    "serve.hedge.delay_ms": "rolling-quantile hedge delay currently in force",
+    "serve.degraded.active": "1 while the router serves degraded replies",
 }
 
 HISTOGRAMS: dict[str, str] = {

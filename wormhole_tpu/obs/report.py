@@ -132,6 +132,18 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "sched_journal_replays": c.get("sched.journal.replays", 0),
         "sched_journal_compactions": c.get("sched.journal.compactions", 0),
         "sched_rpc_dedup_hits": c.get("sched.rpc.dedup_hits", 0),
+        # overload-protection plane: shed/hedge/degrade tallies the
+        # chaos drills pin their verdicts on
+        "deadline_sheds": c.get("net.deadline.shed", 0),
+        "admit_sheds": c.get("admit.sheds", 0),
+        "serve_sheds_deadline": c.get("serve.shed.deadline", 0),
+        "serve_sheds_busy": c.get("serve.shed.busy", 0),
+        "hedges_issued": c.get("serve.hedge.issued", 0),
+        "hedge_wins": c.get("serve.hedge.wins", 0),
+        "hedges_suppressed": c.get("serve.hedge.suppressed", 0),
+        "degraded_replies": c.get("serve.degraded.replies", 0),
+        "degraded_enters": c.get("serve.degraded.enters", 0),
+        "degraded_exits": c.get("serve.degraded.exits", 0),
     }
     report = {
         "run_id": run_id or os.environ.get("WH_RUN_ID"),

@@ -36,7 +36,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import random
 import socket
 import struct
 import threading
@@ -49,6 +48,7 @@ import numpy as np
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import overload as _overload
 from wormhole_tpu.runtime import retry as _retry
 
 _COMPRESS_MIN = 512  # don't bother compressing tiny buffers
@@ -105,18 +105,28 @@ def busy_reply(retry_ms: float = 25.0) -> dict:
     """Header of the structured backpressure reply. Not an `error`:
     nothing was dispatched, the client should back off `retry_ms`
     (jittered) and resend the SAME frame — for seq-fenced ops the fence
-    stamp is reused, so the eventual apply is still exactly-once."""
+    stamp is reused, so the eventual apply is still exactly-once.
+    Servers pass `AdmissionController.busy_hint_ms()` here so the hint
+    scales with observed reject pressure instead of pinning every
+    bounced client to the same fixed 25 ms re-arrival."""
     return {"busy": 1, "retry_ms": float(retry_ms)}
 
 
-def busy_backoff(header: dict) -> bool:
+def busy_backoff(header: dict, budget: Optional[_retry.RetryBudget] = None
+                 ) -> bool:
     """Client side of the gate: True when `header` is a busy reply, after
-    sleeping its (jittered) hint — the caller just retries its frame."""
+    sleeping its hint under the unified full-jitter policy — the caller
+    just retries its frame.  With a `budget` the sleep is additionally
+    capped to the remaining retry window (and counted against it), so a
+    storm of busy replies can't walk an op past its own deadline."""
     if not header.get("busy"):
         return False
     _BUSY_RETRIES.inc()
     hint = float(header.get("retry_ms", 25.0)) / 1000.0
-    time.sleep(hint * (0.5 + random.random()))
+    if budget is not None:
+        budget.sleep(hint_s=hint)
+    else:
+        _retry.jitter_sleep(hint)
     return True
 
 
@@ -230,6 +240,12 @@ def send_frame(sock_file, header: dict,
         tc = _trace.wire_ctx()
         if tc is not None:
             header["tctx"] = tc
+    # the ambient deadline rides the same way: remaining seconds at
+    # send time (`dl`), re-anchored to the receiver's monotonic clock
+    # in recv_frame — clock skew between hosts never touches it
+    dl = _overload.wire_deadline()
+    if dl is not None:
+        header["dl"] = dl
     h = json.dumps(header).encode()
     _ENCODE_S.observe(time.perf_counter() - t0)
     comp = sum(m["nbytes"] for m in metas if "comp" in m)
@@ -262,6 +278,7 @@ def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
     t0 = time.perf_counter()
     header = json.loads(h)
     decode_s = time.perf_counter() - t0
+    _overload.arm(header)  # anchor a carried deadline: dl -> dl_mono
     total = 4 + hlen
     arrays = {}
     for m in header.get("arrays", []):

@@ -76,6 +76,7 @@ import numpy as np
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import overload as _overload
 from wormhole_tpu.runtime import retry as _retrylib
 from wormhole_tpu.runtime.net import (  # noqa: F401  (re-exported: the wire
     _COMPRESS_MIN, _decode, _encode, _read_exact, InflightGate,
@@ -157,6 +158,11 @@ class _PSHandler(socketserver.StreamRequestHandler):
             node._conns.add(self.connection)
         try:
             self._serve(node)
+        except (OSError, ConnectionError):
+            # a peer that vanished mid-frame (or a client that severed
+            # this socket after a hedged pull won) is an ordinary
+            # disconnect, not a handler error worth a traceback
+            pass
         finally:
             with node._conns_lock:
                 node._conns.discard(self.connection)
@@ -173,22 +179,37 @@ class _PSHandler(socketserver.StreamRequestHandler):
             if got is None:
                 return
             header, arrays, _ = got
-            # backpressure gate (WH_NET_MAX_INFLIGHT): an over-admitted
-            # frame is bounced with a structured busy reply BEFORE
-            # dispatch — nothing was applied, so the client's resend of
-            # the same seq-stamped frame stays exactly-once
-            if not node._gate.try_enter():
-                send_frame(self.wfile, dict(busy_reply(),
+            t_in = time.perf_counter()
+            op = header.get("op")
+            # deadline shed: a frame whose propagated budget expired in
+            # transit is answered without dispatch — the sender's retry
+            # window is already spent, and under overload every shed
+            # admits work someone is still waiting for. Nothing was
+            # applied, so seq fences are untouched.
+            if _overload.should_shed(header):
+                send_frame(self.wfile, dict(_overload.shed_reply(header),
                                             epoch=node.epoch))
+                continue
+            # admission gate (fixed WH_NET_MAX_INFLIGHT or WH_ADMIT_AIMD):
+            # an over-admitted frame is bounced with a structured busy
+            # reply BEFORE dispatch — nothing was applied, so the
+            # client's resend of the same seq-stamped frame stays
+            # exactly-once. Control ops (hello/init/...) always pass.
+            if not node._gate.try_enter(op):
+                send_frame(self.wfile,
+                           dict(busy_reply(node._gate.busy_hint_ms()),
+                                epoch=node.epoch))
                 continue
             try:
                 # adopt the trace context a sampled sync round carried
                 # so this shard's spans stitch under the client's round
-                with _trace.bind_wire(header):
+                # — and its remaining deadline, for downstream budgets
+                with _trace.bind_wire(header), \
+                        _overload.bind(_overload.header_deadline(header)):
                     resp_header, resp_arrays = node._dispatch(header,
                                                               arrays)
             finally:
-                node._gate.leave()
+                node._gate.leave(op, time.perf_counter() - t_in)
             if (header.get("op") == "hello" and header.get("net_compress")
                     and node.net_compress):
                 fc = True
@@ -308,7 +329,7 @@ class ServerNode:
         self.net_compress = _env_flag("WH_NET_COMPRESS")
         # max-in-flight admission gate (WH_NET_MAX_INFLIGHT; default
         # unlimited = a single None check per frame)
-        self._gate = InflightGate()
+        self._gate = _overload.AdmissionController()
         self._srv = _PSServer((host, port), _PSHandler)
         self._srv.node = self  # type: ignore
         self.num_push = 0
@@ -1134,6 +1155,9 @@ class PSClient:
         # push/pull (one socket per server, per-rank client state — the
         # only shared mutables are behind _stats_lock)
         self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        # hedged pulls (WH_HEDGE): None when off, so the per-attempt
+        # cost of the feature is one attribute check
+        self._hedge = _overload.hedge_tracker()
 
     def _file(self, r: int):  # wormlint: thread-owned
         if self._files[r] is None:
@@ -1170,6 +1194,83 @@ class PSClient:
         h, arrs, received = got
         return h, arrs, sent, received
 
+    def _attempt_hedged(self, r: int, header: dict, arrays,
+                        fixed_bytes: int,
+                        compress: bool) -> tuple[dict, dict, int, int]:
+        """A pull attempt with tail insurance (WH_HEDGE): after the
+        rolling-quantile delay a backup copy of the frame goes out on a
+        fresh ephemeral connection. Pulls are idempotent reads with no
+        seq fence, so the duplicate is harmless by construction; the
+        budget (WH_HEDGE_BUDGET_PCT) bounds the extra load. Gated off
+        for non-pull ops and under keycache/compression, whose
+        per-connection negotiated state a second connection would not
+        share. If the backup answers first it severs the pooled socket
+        so the primary's blocked recv turns into the error path, which
+        hands back the backup's reply."""
+        delay = (self._hedge.delay_s() if self._hedge is not None
+                 and header.get("op") == "pull"
+                 and not self.keycache and not self.net_compress
+                 and not compress else None)
+        if delay is None:
+            return self._attempt(r, header, arrays, fixed_bytes, compress)
+        done = threading.Event()
+        lock = threading.Lock()
+        state: dict = {}
+
+        def fire():  # wormlint: thread-entry
+            if done.is_set() or not self._hedge.try_issue():
+                return
+            try:
+                host, port = self.uris[r].rsplit(":", 1)
+                sock = connect_with_retry((host, int(port)), 1.0)
+                try:
+                    f = sock.makefile("rwb")
+                    sent = send_frame(f, header, arrays, fixed_bytes,
+                                      False)
+                    got = recv_frame(f)
+                    if got is None or got[0].get("busy"):
+                        return  # dead or busy backup: primary decides
+                    h, arrs, received = got
+                    with lock:
+                        if not done.is_set():
+                            state["reply"] = (h, arrs, sent, received)
+                            s = self._socks[r]
+                            if s is not None:
+                                try:
+                                    s.shutdown(socket.SHUT_RDWR)
+                                except OSError:
+                                    pass
+                finally:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            except Exception:
+                pass  # best-effort tail insurance; the primary decides
+
+        timer = threading.Timer(delay, fire)
+        timer.daemon = True
+        timer.start()
+        try:
+            t0 = time.monotonic()
+            got = self._attempt(r, header, arrays, fixed_bytes, compress)
+            with lock:
+                done.set()
+            self._hedge.observe(time.monotonic() - t0)
+            return got
+        except OSError:
+            with lock:
+                done.set()
+                if "reply" in state:
+                    self._hedge.won()
+                    # the pooled connection was severed to unblock us;
+                    # drop it so the next RPC redials cleanly
+                    self.close(r)
+                    return state["reply"]
+            raise
+        finally:
+            timer.cancel()
+
     def _note_epoch(self, r: int, h: dict) -> None:  # wormlint: thread-owned
         ep = h.get("epoch")
         if ep is None:
@@ -1201,21 +1302,26 @@ class PSClient:
             header = dict(header, sender=self.sender, seq=self._seq[r])
         t_rpc = time.monotonic()
         recovered = False
-        # a saturated server (WH_NET_MAX_INFLIGHT) answers `busy` without
-        # dispatching; resending the same stamped frame is exactly-once,
-        # so just back off and retry — bounded so a wedged server still
-        # fails loudly instead of spinning forever
-        busy_deadline = t_rpc + max(self.retry_deadline, 60.0)
+        # a saturated server answers `busy` without dispatching;
+        # resending the same stamped frame is exactly-once, so back off
+        # under the unified full-jitter policy (the budget caps each
+        # sleep to the window and counts it) — bounded so a wedged
+        # server still fails loudly instead of spinning forever
+        busy_budget = None
         while True:
             try:
-                h, arrs, sent, received = self._attempt(
+                h, arrs, sent, received = self._attempt_hedged(
                     r, header, arrays, fixed_bytes, compress)
-                if busy_backoff(h):
-                    if time.monotonic() >= busy_deadline:
+                if h.get("busy"):
+                    if busy_budget is None:  # minted on first bounce only
+                        busy_budget = _retrylib.RetryBudget(
+                            max(self.retry_deadline, 60.0), op="ps.busy")
+                    if busy_budget.expired:
                         raise RuntimeError(
                             f"ps server {self.uris[r]} still busy after "
                             f"{time.monotonic() - t_rpc:.0f}s of backoff "
                             f"during '{op_name}'")
+                    busy_backoff(h, busy_budget)
                     continue
                 break
             except OSError as e:
@@ -1440,15 +1546,20 @@ class PSClient:
                 max_workers=min(self.world, 8),
                 thread_name_prefix="ps-rpc")
         ctx = _trace.current_ctx()
-        if ctx is not None:
+        dl = _overload.current()
+        if ctx is not None or dl is not None:
             # pool threads don't inherit thread-locals: rebind the
-            # sampled sync round's trace context so each per-rank RPC
-            # frame carries it to its server shard
+            # sampled sync round's trace context (so each per-rank RPC
+            # frame carries it to its server shard) and the round's
+            # ambient deadline (so those frames keep their budget)
             inner = fn
 
-            def fn(r, _inner=inner, _ctx=ctx):
-                with _trace.bind(_ctx):
-                    return _inner(r)
+            def fn(r, _inner=inner, _ctx=ctx, _dl=dl):
+                with _overload.bind(_dl):
+                    if _ctx is None:
+                        return _inner(r)
+                    with _trace.bind(_ctx):
+                        return _inner(r)
         futs = [self._pool.submit(fn, r) for r in range(self.world)]
         return [f.result() for f in futs]
 

@@ -28,6 +28,7 @@ from typing import Optional
 
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.runtime import overload as _overload
 
 _ATTEMPTS = _obs.REGISTRY.counter("retry.attempts")
 _GIVE_UPS = _obs.REGISTRY.counter("retry.give_ups")
@@ -111,6 +112,15 @@ class RetryBudget:
             time.sleep(dur)
         return dur
 
+    def bind(self):
+        """Bind this budget's deadline as the thread's ambient deadline
+        for a block: every frame sent inside it carries the remaining
+        budget on the wire, and servers shed the work if it expires in
+        transit (runtime/overload.py). This is how budgets MINT the
+        propagated deadline — the op's retry window and its wire
+        deadline are one number."""
+        return _overload.bind(self.deadline)
+
     def succeeded(self) -> None:
         """Record a success that needed at least one retry (callers that
         succeed first try never touch the budget's counters)."""
@@ -130,6 +140,23 @@ class RetryBudget:
             + (f" ({self.op})" if self.op else ""))
 
 
+def jitter_sleep(hint_s: float) -> float:
+    """One full-jitter backoff sleep for paths that carry no
+    RetryBudget (e.g. a busy-reply hint on a first-try RPC).  Same
+    jitter law and `retry.*` accounting as `RetryBudget.sleep`, and
+    still capped to the thread's ambient deadline so a budgeted caller
+    higher up the stack can't be slept past its own deadline."""
+    _ATTEMPTS.inc()
+    dur = hint_s * (0.5 + random.random())
+    rem = _overload.remaining()
+    if rem is not None:
+        dur = min(dur, max(rem, 0.0))
+    if dur > 0:
+        _BACKOFF_S.observe(dur)
+        time.sleep(dur)
+    return dur
+
+
 def connect(addr: tuple[str, int], deadline_s: float = 30.0,
             timeout: float = 60.0, op: str = "connect",
             on_retry=None) -> socket.socket:
@@ -138,7 +165,17 @@ def connect(addr: tuple[str, int], deadline_s: float = 30.0,
     `deadline_s` elapses, then the last OSError propagates (counted as a
     give-up).  `timeout` is the established socket's I/O timeout;
     `on_retry` lets a caller keep its own per-failure counter (net.py's
-    `net.connect_retries`) next to the policy-wide `retry.*` ones."""
+    `net.connect_retries`) next to the policy-wide `retry.*` ones.
+
+    Both windows are clamped to the thread's ambient propagated
+    deadline when one is bound: a dial may never outlive the budget of
+    the operation it serves (a caller with 2s left must not sit in a
+    30s dial loop or a 60s blocking connect)."""
+    rem = _overload.remaining()
+    if rem is not None:
+        rem = max(rem, 1e-3)  # expired: one fast attempt, then give up
+        deadline_s = min(deadline_s, rem)
+        timeout = min(timeout, rem)
     budget = RetryBudget(deadline_s, op=op)
     while True:
         try:

@@ -40,6 +40,7 @@ from wormhole_tpu.obs import prom as _prom
 from wormhole_tpu.obs import slo as _slo
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import overload as _overload
 from wormhole_tpu.runtime import retry as _retry
 from wormhole_tpu.runtime.net import connect_with_retry
 from wormhole_tpu.runtime.sched_journal import SchedulerJournal
@@ -80,6 +81,13 @@ _JOURNALED_OPS = frozenset({
     "register_bsp", "bsp_leave", "add_local", "finish", "report",
     "blob_put", "blob_del", "barrier", "bye",
 })
+
+# Ops an overloaded scheduler may shed when their propagated deadline
+# expired in transit.  Deliberately tiny: everything else the tracker
+# handles IS the control plane (membership, barriers, heartbeats,
+# registration) whose loss converts overload into spurious evictions.
+# `metrics` is pure telemetry pull — dropping a stale one is free.
+_SHEDDABLE_SCHED_OPS = frozenset({"metrics"})
 
 
 def _worker_rank(node: str) -> int:
@@ -672,6 +680,13 @@ class Scheduler:
         op = req.get("op")
         t0 = time.perf_counter()
         try:
+            # deadline shed, telemetry ops only (control ops always
+            # dispatch): anchor the carried relative deadline and bounce
+            # the request if its budget was spent in transit
+            _overload.arm(req)
+            if op in _SHEDDABLE_SCHED_OPS and _overload.should_shed(req):
+                return dict(_overload.shed_reply(req),
+                            inc=self.incarnation)
             sender, seq = req.get("sender"), req.get("seq")
             if sender is not None and seq is not None:
                 with self._lock:
@@ -1333,9 +1348,15 @@ class SchedulerClient:
             with self._seq_lock:
                 self._seq += 1
                 req["sender"], req["seq"] = self._sender, self._seq
-        payload = json.dumps(req) + "\n"
         budget = None
         while True:
+            # (re)stamp the remaining ambient budget per ATTEMPT — a
+            # retry after backoff has less budget left than the first
+            # send did, and the scheduler sheds on what the frame says
+            dl = _overload.wire_deadline()
+            if dl is not None:
+                req["dl"] = dl
+            payload = json.dumps(req) + "\n"
             try:
                 with connect_with_retry(self.addr, self.connect_deadline,
                                         self.timeout) as s:

@@ -26,6 +26,8 @@ retried fetch can never straddle two versions. Busy bounces
 
 from __future__ import annotations
 
+import heapq
+import socket as _socket
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -36,6 +38,7 @@ import numpy as np
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
+from wormhole_tpu.runtime import overload as _overload
 from wormhole_tpu.runtime import retry as _retrylib
 from wormhole_tpu.runtime.net import (
     busy_backoff, connect_with_retry, recv_frame, send_frame,
@@ -46,6 +49,9 @@ _ROUTER_REQUESTS = _obs.REGISTRY.counter("serve.router.requests")
 _ROUTER_RETRIES = _obs.REGISTRY.counter("serve.router.retries")
 _EPOCH_RETRIES = _obs.REGISTRY.counter("serve.router.epoch_retries")
 _FAILURES = _obs.REGISTRY.counter("serve.router.failures")
+# same series the shard's pre-dispatch shed uses: "requests shed on an
+# expired deadline", wherever in the stack the expiry was caught
+_SHED_DEADLINE = _obs.REGISTRY.counter("serve.shed.deadline")
 _LATENCY_S = _obs.REGISTRY.histogram("serve.latency_s")
 
 # stage decomposition of one predict request (docs/serving.md): the
@@ -60,6 +66,83 @@ _STAGE_SCORE_S = _obs.REGISTRY.histogram("serve.stage.score_s")
 _STAGE_SUM_S = _obs.REGISTRY.histogram("serve.stage.sum_s")
 
 _EPOCH_REPLAYS = 8  # fan-out replays before a mixed-version batch fails
+
+
+class _HedgeTimer:
+    """One long-lived scheduler thread multiplexing every pending hedge
+    arm. ``threading.Timer`` spawns a THREAD per arm; at serving rates
+    (2 fetches x hundreds of qps) that thread churn alone costs
+    double-digit percent of capacity — measured 355 -> 301 qps on the
+    serve lab's closed-loop probe. Here arming is a heap push; entries
+    whose request completed first (``done`` set) are dropped at fire
+    time, so there is no cancel path to race with."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap: list = []  # (fire_at, tiebreak, fire, done)
+        self._n = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    #: batch scheduler wakeups: hedge delays are tail-scale (tens of
+    #: ms), so a couple ms of firing slack is free — waking per entry
+    #: at serving rates is not
+    _GRANULARITY_S = 0.002
+
+    def arm(self, delay_s: float, fire: Callable[[], None],
+            done: threading.Event) -> None:
+        at = time.monotonic() + delay_s
+        with self._cond:
+            if self._stop:
+                return
+            if self._thread is None:  # lazy: only hedging routers pay
+                self._thread = threading.Thread(
+                    target=self._loop, name="serve-hedge", daemon=True)
+                self._thread.start()
+            self._n += 1
+            # only a new EARLIEST entry moves the scheduler's wake-up
+            # time; notifying per arm would wake it at the full
+            # request rate for nothing
+            is_head = not self._heap or at < self._heap[0][0]
+            heapq.heappush(self._heap, (at, self._n, fire, done))
+            if is_head:
+                self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            due = []
+            with self._cond:
+                while not self._stop:
+                    # purge entries whose request already completed —
+                    # the common case, since only tail requests outlive
+                    # their hedge delay
+                    while self._heap and self._heap[0][3].is_set():
+                        heapq.heappop(self._heap)
+                    if not self._heap:
+                        self._cond.wait()
+                        continue
+                    wait = self._heap[0][0] - time.monotonic()
+                    if wait <= 0:
+                        now = time.monotonic()
+                        while self._heap and self._heap[0][0] <= now:
+                            e = heapq.heappop(self._heap)
+                            if not e[3].is_set():
+                                due.append(e)
+                        break
+                    self._cond.wait(max(wait, self._GRANULARITY_S))
+                if self._stop:
+                    return
+            for _, _, fire, done in due:
+                if not done.is_set():
+                    try:
+                        fire()
+                    except Exception:
+                        pass  # e.g. pool shut down mid-close
 
 
 class _Slot:
@@ -101,10 +184,28 @@ class Router:
         self._uris = list(uris)  # wormlint: guarded-by(self._lock)
         self.world = len(uris)
         self._free: Dict[int, list] = {r: [] for r in range(self.world)}
+        # pooled (sock, file) pairs for hedge backups: a hedge must ride
+        # a DIFFERENT connection than the primary it insures (the win
+        # path severs the primary's socket), but dialing fresh per
+        # hedge costs more than the duplicate fetch itself — dedup is
+        # keyed on the frame's (sender, seq), not the connection
+        self._hedge_free: Dict[int, list] = {
+            r: [] for r in range(self.world)}
         self._slot_ids = 0  # wormlint: guarded-by(self._lock)
         self._pool = ThreadPoolExecutor(
             max_workers=max(8, 2 * self.world),
             thread_name_prefix="serve-router")
+        # overload machinery: hedged fetches (WH_HEDGE — None when off,
+        # so the hot path pays one attribute check) and degraded-mode
+        # serving under sustained SLO burn (WH_DEGRADE)
+        self._hedge = _overload.hedge_tracker()
+        self._hedge_timer = _HedgeTimer()
+        self._degrade = _overload.DegradeController()
+        # client-edge admission (WH_ADMIT_AIMD): overload queues form
+        # HERE, ahead of any shard gate — bounce at entry so admitted
+        # requests see bounded queueing instead of everyone expiring
+        # mid-queue (see overload.router_gate)
+        self._gate = _overload.router_gate()
         # one hello up front: table row counts drive the key split, and
         # a shard configured for a different world would shard-range
         # differently than this router splits
@@ -172,6 +273,110 @@ class Router:
                 self._uris = list(got)
 
     # -- RPC ----------------------------------------------------------------
+    def _send_recv(self, f, r: int, hdr: dict,
+                   arrays: Dict[str, np.ndarray],
+                   budget: Optional[_retrylib.RetryBudget] = None,
+                   abandon_busy: bool = False) -> tuple[dict, dict]:
+        """One send + reply on an established connection, resending the
+        same seq-stamped frame through busy bounces. A hedge passes
+        `abandon_busy`: a busy shard must not absorb EXTRA (backup)
+        load, so the hedge gives up instead of backing off."""
+        send_frame(f, hdr, arrays)
+        while True:
+            got = recv_frame(f)
+            if got is None:
+                raise ConnectionResetError(
+                    f"serve shard {r} closed the connection")
+            reply, rarr, _ = got
+            if reply.get("busy") and abandon_busy:
+                raise _HedgeAbandoned()
+            if busy_backoff(reply, budget):
+                # bounced before dispatch: resend the same seq-stamped
+                # frame after the load-scaled, jittered hint
+                send_frame(f, hdr, arrays)
+                continue
+            return reply, rarr
+
+    def _attempt(self, slot: _Slot, r: int, hdr: dict,
+                 arrays: Dict[str, np.ndarray],
+                 budget: _retrylib.RetryBudget) -> tuple[dict, dict]:
+        """One connected attempt, hedged for fetches when WH_HEDGE is
+        on: the hedge scheduler fires after the rolling-quantile delay
+        and — budget permitting — sends the SAME (sender, seq) frame on
+        a fresh ephemeral connection. The shard's per-sender reply cache makes
+        the duplicate exactly-once (whichever copy dispatches second is
+        answered from the cache with the ORIGINAL bytes), so the hedge
+        can never double-score. If the backup answers first it severs
+        the pooled socket to unblock the primary's recv, and the
+        primary's error path returns the backup's reply."""
+        hedge = self._hedge if hdr.get("op") == "fetch" else None
+        delay = hedge.delay_s() if hedge is not None else None
+        if delay is None:
+            return self._send_recv(slot.f, r, hdr, arrays, budget)
+        done = threading.Event()
+        lock = threading.Lock()
+        state: dict = {}
+
+        def fire():  # wormlint: thread-entry
+            if done.is_set() or not hedge.try_issue():
+                return
+            conn = None
+            ok = False
+            try:
+                with self._lock:
+                    uri = self._uris[r]
+                    if self._hedge_free[r]:
+                        conn = self._hedge_free[r].pop()
+                if conn is None:
+                    host, port = uri.rsplit(":", 1)
+                    sock = connect_with_retry((host, int(port)), 1.0)
+                    conn = (sock, sock.makefile("rwb"))
+                got = self._send_recv(conn[1], r, hdr,
+                                      arrays, abandon_busy=True)
+                ok = True
+                with lock:
+                    if not done.is_set():
+                        state["reply"] = got
+                        # sever the pooled socket: the primary's
+                        # blocked recv turns into the error path,
+                        # which hands back this reply
+                        if slot.sock is not None:
+                            try:
+                                slot.sock.shutdown(_socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+                        slot.close()
+            except Exception:
+                pass  # best-effort tail insurance; the primary decides
+            finally:
+                if conn is not None:
+                    if ok:
+                        with self._lock:
+                            self._hedge_free[r].append(conn)
+                    else:
+                        try:
+                            conn[0].close()
+                        except OSError:
+                            pass
+
+        # the RPC itself runs on the router pool so a slow hedge never
+        # delays OTHER due hedges on the scheduler thread; stale
+        # entries (done already set) are dropped at fire time
+        self._hedge_timer.arm(
+            delay, lambda: self._pool.submit(fire), done)
+        try:
+            got = self._send_recv(slot.f, r, hdr, arrays, budget)
+            with lock:
+                done.set()
+            return got
+        except (OSError, ConnectionError):
+            with lock:
+                done.set()
+                if "reply" in state:
+                    hedge.won()
+                    return state["reply"]
+            raise
+
     def _rpc(self, r: int, header: dict,
              arrays: Dict[str, np.ndarray]) -> tuple[dict, dict]:
         slot = self._acquire(r)
@@ -180,37 +385,34 @@ class Router:
             slot.seq += 1
             budget = _retrylib.RetryBudget(max(self.retry_deadline, 0.0),
                                            base_s=0.1, op="serve.rpc")
-            while True:
-                try:
-                    if slot.f is None:
-                        self._dial(slot, r)
-                    send_frame(slot.f, hdr, arrays)
-                    while True:
-                        got = recv_frame(slot.f)
-                        if got is None:
-                            raise ConnectionResetError(
-                                f"serve shard {r} closed the connection")
-                        reply, rarr, _ = got
-                        if busy_backoff(reply):
-                            # bounced before dispatch: resend the same
-                            # seq-stamped frame after the jittered hint
-                            send_frame(slot.f, hdr, arrays)
-                            continue
-                        break
-                    if "error" in reply:
-                        raise RuntimeError(
-                            f"serve shard {r}: {reply['error']}")
-                    budget.succeeded()
-                    return reply, rarr
-                except (OSError, ConnectionError) as e:
-                    slot.close()
-                    if budget.expired:
-                        budget.give_up(e)
-                    _ROUTER_RETRIES.inc()
-                    # a respawned shard re-registered under a new uri;
-                    # the resolver hands it to the next dial
-                    self._refresh_uris()
-                    budget.sleep()
+            # the budget's window — tightened by any ambient request
+            # deadline — rides every frame sent below as its `dl`
+            with budget.bind():
+                while True:
+                    try:
+                        if slot.f is None:
+                            self._dial(slot, r)
+                        t_req = time.perf_counter()
+                        reply, rarr = self._attempt(slot, r, hdr, arrays,
+                                                    budget)
+                        if "error" in reply:
+                            raise RuntimeError(
+                                f"serve shard {r}: {reply['error']}")
+                        if self._hedge is not None \
+                                and hdr.get("op") == "fetch":
+                            self._hedge.observe(
+                                time.perf_counter() - t_req)
+                        budget.succeeded()
+                        return reply, rarr
+                    except (OSError, ConnectionError) as e:
+                        slot.close()
+                        if budget.expired:
+                            budget.give_up(e)
+                        _ROUTER_RETRIES.inc()
+                        # a respawned shard re-registered under a new
+                        # uri; the resolver hands it to the next dial
+                        self._refresh_uris()
+                        budget.sleep()
         finally:
             self._release(r, slot)
 
@@ -225,17 +427,19 @@ class Router:
             out.append(slice(int(a), int(b)))
         return out
 
-    def _rpc_traced(self, ctx, r: int, header: dict,
+    def _rpc_traced(self, ctx, dl, r: int, header: dict,
                     arrays: Dict[str, np.ndarray]) -> tuple[dict, dict]:
         """Pool-thread RPC entry: rebind the request's trace context
-        (executor threads don't inherit thread-locals) so the frame
-        carries it over the wire and the shard's span links back."""
-        if ctx is None:
-            return self._rpc(r, header, arrays)
-        with _trace.bind(ctx):
-            with _trace.request_span("serve.rpc.fetch", cat="serve",
-                                     shard=r):
+        AND its deadline (executor threads don't inherit thread-locals)
+        so the frame carries both over the wire and the shard's span
+        links back."""
+        with _overload.bind(dl):
+            if ctx is None:
                 return self._rpc(r, header, arrays)
+            with _trace.bind(ctx):
+                with _trace.request_span("serve.rpc.fetch", cat="serve",
+                                         shard=r):
+                    return self._rpc(r, header, arrays)
 
     def _fanout(self, packed) -> tuple[list, list, int]:
         """One fetch round: returns (jobs, replies, model version) or
@@ -253,14 +457,15 @@ class Router:
                       for t in present}
             jobs.append((r, present, arrays))
         ctx = _trace.current_ctx()
+        dl = _overload.current()
         futs = [self._pool.submit(
-            self._rpc_traced, ctx, r,
+            self._rpc_traced, ctx, dl, r,
             {"op": "fetch", "tables": present}, arrays)
             for r, present, arrays in jobs]
         got = [f.result() for f in futs]
         versions = {int(reply["version"]) for reply, _ in got}
         if len(versions) > 1:
-            raise _MixedVersions(versions)
+            raise _MixedVersions(versions, jobs, got)
         return jobs, got, versions.pop()
 
     def _merge(self, jobs: list, got: list) -> Dict[str, np.ndarray]:
@@ -275,16 +480,52 @@ class Router:
 
     def predict_block(self, blk) -> tuple[np.ndarray, int]:
         """Score one RowBlock; returns (scores[:size], model version).
-        The scores are guaranteed to come from ONE snapshot version."""
-        ctx = _trace.start_request()
-        with _trace.bind(ctx):
-            with _trace.request_span("serve.request", cat="serve"):
-                return self._predict_block(blk)
+        Outside degraded mode the scores are guaranteed to come from
+        ONE snapshot version (use `predict_block_ex` to see the
+        degraded stamp)."""
+        scores, version, _ = self.predict_block_ex(blk)
+        return scores, version
 
-    def _predict_block(self, blk) -> tuple[np.ndarray, int]:
+    def predict_block_ex(self, blk) -> tuple[np.ndarray, int, dict]:
+        """`predict_block` plus the reply metadata: ``degraded`` (1 =
+        bounded-staleness mixed-version scores served under sustained
+        SLO burn, stamped per the overload contract) and, when
+        degraded, the ``versions`` the rows spanned."""
+        ctx = _trace.start_request()
+        # default per-request deadline (WH_DEADLINE_MS): bound only
+        # when the caller didn't bind one — an explicit caller budget
+        # always wins
+        dl_ms = float(knob_value("WH_DEADLINE_MS"))
+        dl_cm = (_overload.bind_in(dl_ms / 1e3)
+                 if dl_ms > 0 and _overload.current() is None
+                 else _overload.bind(None))
+        with dl_cm, _trace.bind(ctx):
+            # already-expired budget: shed before paying for pack or
+            # fan-out — the shards would only bounce it at dispatch
+            rem = _overload.remaining()
+            if (rem is not None and rem <= 0
+                    and knob_value("WH_DEADLINE_SHED")):
+                _SHED_DEADLINE.inc()
+                raise _overload.Shed(
+                    "deadline expired before router fan-out")
+            gate = self._gate
+            if gate is not None and not gate.try_enter("predict"):
+                raise _overload.Shed(
+                    f"router admission: saturated "
+                    f"(limit {gate.limit}, {gate.inflight} in flight)")
+            t0 = time.perf_counter()
+            try:
+                with _trace.request_span("serve.request", cat="serve"):
+                    return self._predict_block(blk)
+            finally:
+                if gate is not None:
+                    gate.leave("predict", time.perf_counter() - t0)
+
+    def _predict_block(self, blk) -> tuple[np.ndarray, int, dict]:
         t0 = time.perf_counter()
         packed = self.scorer.pack(blk)
         _STAGE_PACK_S.observe(time.perf_counter() - t0)
+        meta = {"degraded": 0}
         try:
             for attempt in range(_EPOCH_REPLAYS):
                 tf0 = time.perf_counter()
@@ -292,17 +533,32 @@ class Router:
                     with _trace.request_span("serve.stage.fanout",
                                              cat="serve"):
                         jobs, got, version = self._fanout(packed)
-                except _MixedVersions:
-                    # a hot swap landed mid-fan-out; replay against the
-                    # (now uniform) new version. Shard watchers can be
-                    # skewed by up to their poll interval, so back off
-                    # exponentially until the replays span at least one
-                    # full WH_SERVE_POLL_SEC — immediate replays would
-                    # all burn inside the skew window
+                except _MixedVersions as mv:
                     _EPOCH_RETRIES.inc()
-                    poll = float(knob_value("WH_SERVE_POLL_SEC"))
-                    time.sleep(min(0.01 * (2 ** attempt), max(poll, 0.01)))
-                    continue
+                    # replays burn latency budget; they feed the burn
+                    # window that arms degraded mode
+                    self._degrade.observe_replay()
+                    if self._degrade.active():
+                        # degraded mode: stop paying for strict version
+                        # consistency — serve the mixed-version rows we
+                        # already hold, stamped so the caller knows
+                        jobs, got = mv.jobs, mv.got
+                        version = max(mv.versions)
+                        meta = {"degraded": 1,
+                                "versions": sorted(mv.versions)}
+                        self._degrade.served_degraded()
+                    else:
+                        # a hot swap landed mid-fan-out; replay against
+                        # the (now uniform) new version. Shard watchers
+                        # can be skewed by up to their poll interval,
+                        # so back off exponentially until the replays
+                        # span at least one full WH_SERVE_POLL_SEC —
+                        # immediate replays would all burn inside the
+                        # skew window
+                        poll = float(knob_value("WH_SERVE_POLL_SEC"))
+                        time.sleep(min(0.01 * (2 ** attempt),
+                                       max(poll, 0.01)))
+                        continue
                 fanout = time.perf_counter() - tf0
                 # wire share = fan-out wall minus the slowest shard's
                 # own (queue + serve) time, which replies carry back
@@ -323,8 +579,10 @@ class Router:
                 scores = self.scorer.score(packed, rows)
                 _STAGE_SCORE_S.observe(time.perf_counter() - ts0)
                 _ROUTER_REQUESTS.inc()
-                _LATENCY_S.observe(time.perf_counter() - t0)
-                return scores, version
+                lat = time.perf_counter() - t0
+                _LATENCY_S.observe(lat)
+                self._degrade.observe(lat)
+                return scores, version, meta
             raise RuntimeError(
                 f"shard versions never agreed after {_EPOCH_REPLAYS} "
                 "fan-out replays")
@@ -333,14 +591,37 @@ class Router:
             raise
 
     def close(self) -> None:
+        self._hedge_timer.close()
         self._pool.shutdown(wait=False)
         with self._lock:
             slots = [s for free in self._free.values() for s in free]
             for free in self._free.values():
                 free.clear()
+            hconns = [c for free in self._hedge_free.values()
+                      for c in free]
+            for free in self._hedge_free.values():
+                free.clear()
         for s in slots:
             s.close()
+        for sock, _ in hconns:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class _MixedVersions(Exception):
-    """Fan-out replies spanned a hot swap (internal replay signal)."""
+    """Fan-out replies spanned a hot swap. Internal replay signal that
+    carries the mixed payload, so degraded mode can serve it as a
+    bounded-staleness reply instead of discarding the round."""
+
+    def __init__(self, versions: set, jobs: list, got: list):
+        super().__init__(f"mixed shard versions {sorted(versions)}")
+        self.versions = versions
+        self.jobs = jobs
+        self.got = got
+
+
+class _HedgeAbandoned(Exception):
+    """A hedge met a busy shard and gave up (a backup request must
+    never add load a primary would have backed off from)."""

@@ -40,9 +40,9 @@ import numpy as np
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
-from wormhole_tpu.runtime.net import (
-    InflightGate, busy_reply, recv_frame, send_frame,
-)
+from wormhole_tpu.runtime import faults
+from wormhole_tpu.runtime import overload as _overload
+from wormhole_tpu.runtime.net import busy_reply, recv_frame, send_frame
 from wormhole_tpu.utils import manifest as _manifest
 
 _REQUESTS = _obs.REGISTRY.counter("serve.requests")
@@ -51,6 +51,8 @@ _SWAPS = _obs.REGISTRY.counter("serve.swaps")
 _DEDUP_HITS = _obs.REGISTRY.counter("serve.dedup_hits")
 _MODEL_EPOCH = _obs.REGISTRY.gauge("serve.model_epoch")
 _SWAP_STALL_S = _obs.REGISTRY.histogram("serve.swap_stall_s")
+_SHED_DEADLINE = _obs.REGISTRY.counter("serve.shed.deadline")
+_SHED_BUSY = _obs.REGISTRY.counter("serve.shed.busy")
 
 _TORN_RETRIES = 3
 
@@ -125,6 +127,11 @@ class _ServeHandler(socketserver.StreamRequestHandler):
             srv._conns.add(self.connection)
         try:
             self._serve(srv)
+        except (OSError, ConnectionError):
+            # a peer that vanished mid-frame (or a router that severed
+            # this socket after a hedge win) is an ordinary disconnect,
+            # not a handler error worth a traceback
+            pass
         finally:
             with srv._conns_lock:
                 srv._conns.discard(self.connection)
@@ -136,22 +143,44 @@ class _ServeHandler(socketserver.StreamRequestHandler):
                 return
             header, arrays, _ = got
             t_in = time.perf_counter()
-            # WH_NET_MAX_INFLIGHT admission gate, same contract as the
-            # PS shards: a bounced frame was never dispatched, so the
-            # client resends the SAME seq and the reply cache keeps the
-            # retry exactly-once
-            if not srv._gate.try_enter():
+            op = header.get("op")
+            # a frame whose propagated deadline expired in transit gets
+            # a shed reply, not a handler: nobody is waiting for the
+            # result, and under overload every shed admits a request
+            # someone IS still waiting for
+            if _overload.should_shed(header):
+                _SHED_DEADLINE.inc()
+                send_frame(self.wfile, dict(_overload.shed_reply(header),
+                                            version=srv.version))
+                continue
+            # admission gate (fixed WH_NET_MAX_INFLIGHT or AIMD), same
+            # contract as the PS shards: a bounced frame was never
+            # dispatched, so the client resends the SAME seq and the
+            # reply cache keeps the retry exactly-once
+            if not srv._gate.try_enter(op):
+                _SHED_BUSY.inc()
                 send_frame(self.wfile,
-                           dict(busy_reply(), version=srv.version))
+                           dict(busy_reply(srv._gate.busy_hint_ms()),
+                                version=srv.version))
                 continue
             try:
+                # chaos hook: a serve shard sends no request frames of
+                # its own, so the net-fault send hook never sees its
+                # ops — arm them at dispatch instead. net:slow@fetch
+                # models a slow shard; the sleep lands inside the gate
+                # so AIMD and the SLO burn see the degraded service time
+                if faults.ACTIVE is not None:
+                    faults.ACTIVE.frame(op)
                 # adopt the trace context a sampled request carried, so
-                # this shard's spans stitch under the router's fan-out
-                with _trace.bind_wire(header):
+                # this shard's spans stitch under the router's fan-out —
+                # and the request's remaining deadline, so downstream
+                # work this handler does inherits the budget
+                with _trace.bind_wire(header), \
+                        _overload.bind(_overload.header_deadline(header)):
                     resp_header, resp_arrays = srv._dispatch(
                         header, arrays, t_in)
             finally:
-                srv._gate.leave()
+                srv._gate.leave(op, time.perf_counter() - t_in)
             send_frame(self.wfile, resp_header, resp_arrays)
             if header.get("op") == "shutdown":
                 srv._shutdown.set()
@@ -189,7 +218,7 @@ class ModelServer:
         # so caching the latest reply covers every retry pattern
         self._replies: Dict[str, tuple] = {}
         self._replies_lock = threading.Lock()
-        self._gate = InflightGate()
+        self._gate = _overload.AdmissionController()
         self._shutdown = threading.Event()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
