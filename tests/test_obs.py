@@ -346,6 +346,11 @@ def test_obs_smoke_linear_job(tmp_path, retrace):
                            "nodes"}
     # the solver's Perf mirror put step timings in the registry
     assert any(k.startswith("perf.") for k in report["hists"])
+    # training-step stage attribution: the train thread's pipeline
+    # stages (load + step + metrics) must explain the per-batch wall
+    tstages = report["train_stages"]
+    assert {"load", "step", "metrics"} <= set(tstages["stages"])
+    assert tstages["explained_frac"] >= 0.9
     traces = [f for f in os.listdir(obs_dir)
               if f.startswith("trace-") and f.endswith(".jsonl")]
     assert len(traces) == 1

@@ -1,0 +1,325 @@
+"""Flight-recorder + continuous-profiler tests: off-is-free contracts,
+ring retention and dump format, dump rate limiting, the trace->flight
+feed, multi-node blackbox merging (including truncated dumps), the
+scheduler `flight` verb with its cluster-wide fgen piggyback, the
+sampling profiler's overhead budget, and the train-stage report
+table."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from wormhole_tpu.obs import flight as obs_flight
+from wormhole_tpu.obs import metrics as obs_metrics
+from wormhole_tpu.obs import pyprof as obs_pyprof
+from wormhole_tpu.obs import report as obs_report
+from wormhole_tpu.obs import trace as obs_trace
+from wormhole_tpu.runtime.tracker import Scheduler, SchedulerClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def reflight(monkeypatch):
+    """Re-init flight/prof/trace around a test and guarantee all three
+    end disabled (the modules init from env at import)."""
+    yield monkeypatch
+    for k in ("WH_FLIGHT", "WH_FLIGHT_DIR", "WH_FLIGHT_RING",
+              "WH_FLIGHT_DECISIONS", "WH_FLIGHT_SNAPS",
+              "WH_FLIGHT_MIN_SEC", "WH_PROF", "WH_PROF_HZ",
+              "WH_PROF_BUDGET_PCT", "WH_OBS_DIR", "WH_RUN_ID"):
+        monkeypatch.delenv(k, raising=False)
+    obs_flight.init_from_env()
+    obs_pyprof.init_from_env()
+    obs_trace.init_from_env()
+    assert obs_flight.ACTIVE is None
+    assert obs_pyprof.ACTIVE is None
+    assert obs_trace.ACTIVE is None
+
+
+def _dump_lines(path: str) -> list[dict]:
+    return [json.loads(l) for l in open(path)]
+
+
+# ------------------------------------------------------- off = zero cost
+def test_flight_off_every_hook_is_noop(reflight):
+    reflight.delenv("WH_FLIGHT", raising=False)
+    assert obs_flight.init_from_env() is None
+    obs_flight.record_decision("shed", "nope", op="fetch")
+    obs_flight.record_hop("push", 0.125)
+    obs_flight.record_stack(["main;x 1"])
+    assert obs_flight.dump("nothing", force=True) is None
+    # with trace AND flight off, span() stays the shared null object
+    reflight.delenv("WH_OBS_DIR", raising=False)
+    obs_trace.init_from_env()
+    assert obs_trace.span("a") is obs_trace.span("b")
+
+
+def test_pyprof_off_no_thread_and_tag_is_cheap(reflight):
+    reflight.delenv("WH_PROF", raising=False)
+    assert obs_pyprof.init_from_env() is None
+    assert obs_pyprof.ACTIVE is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "wh-pyprof"]
+    obs_pyprof.tag_thread("train")  # always-on, must not raise
+    assert obs_pyprof._role_of(threading.get_ident(), "x") == "train"
+    del obs_pyprof._ROLES[threading.get_ident()]
+
+
+# ----------------------------------------------------- rings + dump file
+def test_flight_rings_bound_and_dump_format(tmp_path, reflight):
+    reflight.setenv("WH_FLIGHT", "1")
+    reflight.setenv("WH_FLIGHT_DIR", str(tmp_path))
+    reflight.setenv("WH_FLIGHT_RING", "4")
+    reflight.setenv("WH_FLIGHT_DECISIONS", "2")
+    reflight.setenv("WH_RUN_ID", "fl-run")
+    fr = obs_flight.init_from_env()
+    assert fr is not None and obs_flight.ACTIVE is fr
+    for i in range(10):
+        fr.record_span(f"span.{i}", "t", time.monotonic(), 0.001)
+    for i in range(5):
+        obs_flight.record_decision("shed", f"reason-{i}", op="fetch",
+                                   budget_ms=1.5)
+    obs_flight.record_hop("push", 0.125)
+    path = obs_flight.dump("unit-test", force=True)
+    assert path and os.path.basename(path).startswith(
+        f"flight-{fr.node}-{fr.pid}-")
+    lines = _dump_lines(path)
+    anchor = lines[0]
+    assert anchor["ph"] == "M" and anchor["kind"] == "flight"
+    assert anchor["run"] == "fl-run" and anchor["reason"] == "unit-test"
+    assert "wall" in anchor and "mono" in anchor
+    records = lines[1:]
+    # rings kept only the newest: 4 spans of 10, 2 decisions of 5
+    spans = [r for r in records if r["name"].startswith("span.")]
+    assert [r["name"] for r in spans] == [f"span.{i}" for i in
+                                          (6, 7, 8, 9)]
+    decisions = [r for r in records if r["cat"] == "overload"
+                 and r["name"] != "net.hop"]
+    assert [d["args"]["reason"] for d in decisions] == ["reason-3",
+                                                        "reason-4"]
+    assert decisions[0]["name"] == "overload.shed"
+    assert decisions[0]["args"]["verdict"] == "shed"
+    assert decisions[0]["args"]["budget_ms"] == 1.5
+    hop = next(r for r in records if r["name"] == "net.hop")
+    assert hop["args"] == {"op": "push", "budget_ms": 125.0}
+    # records are time-ordered for the timeline merge
+    ts = [r["ts"] for r in records]
+    assert ts == sorted(ts)
+    # a metric snapshot rode along
+    assert any(r["name"] == "flight.snapshot" for r in records)
+
+
+def test_flight_dump_rate_limit_and_force(tmp_path, reflight):
+    reflight.setenv("WH_FLIGHT", "1")
+    reflight.setenv("WH_FLIGHT_DIR", str(tmp_path))
+    reflight.setenv("WH_FLIGHT_MIN_SEC", "60")
+    fr = obs_flight.init_from_env()
+    fr.record_span("s", "t", time.monotonic(), 0.001)
+    suppressed = obs_metrics.REGISTRY.counter("flight.suppressed")
+    before = suppressed.value()
+    assert fr.dump("first") is not None
+    assert fr.dump("storm") is None          # rate-limited
+    assert suppressed.value() == before + 1
+    assert fr.dump("forced", force=True) is not None
+    assert len(os.listdir(tmp_path)) == 2
+
+
+def test_trace_spans_feed_flight_without_file_tracer(tmp_path, reflight):
+    """The recorder is a second span sink: spans/events must reach it
+    even when WH_OBS_DIR file tracing is off."""
+    reflight.setenv("WH_FLIGHT", "1")
+    reflight.setenv("WH_FLIGHT_DIR", str(tmp_path))
+    reflight.delenv("WH_OBS_DIR", raising=False)
+    obs_flight.init_from_env()
+    assert obs_trace.init_from_env() is None  # no file tracer...
+    with obs_trace.span("flight.fed.span", cat="t", n=3):
+        pass
+    obs_trace.event("flight.fed.event", cat="t")
+    lines = _dump_lines(obs_flight.dump("feed-test", force=True))
+    span = next(l for l in lines if l.get("name") == "flight.fed.span")
+    assert span["ph"] == "X" and span["args"] == {"n": 3}
+    assert span["dur"] >= 0
+    assert any(l.get("name") == "flight.fed.event" for l in lines)
+
+
+# -------------------------------------------------------------- blackbox
+def test_blackbox_merges_multinode_and_names_decisions(tmp_path,
+                                                       reflight):
+    a = obs_flight.FlightRecorder(str(tmp_path), "bb-run", "worker-0")
+    b = obs_flight.FlightRecorder(str(tmp_path), "bb-run", "serve-1")
+    a.record_span("solver.train_step", "solver", time.monotonic(), 0.01)
+    a.record_decision("hedge", "delay quantile elapsed", op="fetch")
+    b.record_decision("admit_shed", "inflight 8 >= limit 8", op="fetch")
+    b.record_hop("fetch", 0.350)
+    pa = a.dump("slo-burn: serve_latency", force=True)
+    pb = b.dump("cluster: slo-burn: serve_latency", force=True)
+    bb = _load_tool("blackbox")
+    paths = bb.flight_paths(str(tmp_path))
+    assert paths == sorted([pa, pb])
+    merged = bb.merge_dumps(paths)
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {f"worker-0/{a.pid}", f"serve-1/{b.pid}"}
+    assert any(e["name"] == "overload.admit_shed"
+               for e in merged["traceEvents"])
+    summary = "\n".join(bb.summarize(paths))
+    # the post-mortem names every decision WITH its recorded reason
+    assert "admit_shed" in summary
+    assert "inflight 8 >= limit 8" in summary
+    assert "hedge" in summary and "delay quantile elapsed" in summary
+    assert "slo-burn: serve_latency" in summary
+
+
+def test_blackbox_tolerates_truncated_and_anchorless_dumps(tmp_path,
+                                                           reflight):
+    fr = obs_flight.FlightRecorder(str(tmp_path), "bb-run", "worker-0")
+    fr.record_decision("shed", "deadline expired in transit", op="push")
+    path = fr.dump("fault: net:reset", force=True)
+    # a crash mid-write tears the final line
+    with open(path, "a") as fh:
+        fh.write('{"ph":"i","name":"torn","ts":')
+    # and a file that lost its anchor line entirely is skipped, not fatal
+    bad = os.path.join(tmp_path, "flight-dead-1-1.jsonl")
+    with open(bad, "w") as fh:
+        fh.write('{"ph":"i","name":"orphan","ts":1.0}\n')
+    bb = _load_tool("blackbox")
+    paths = bb.flight_paths(str(tmp_path))
+    assert len(paths) == 2
+    merged = bb.merge_dumps(paths)
+    assert not any(e["name"] == "torn" for e in merged["traceEvents"])
+    assert any(e["name"] == "overload.shed"
+               for e in merged["traceEvents"])
+    summary = "\n".join(bb.summarize(paths))
+    assert "deadline expired in transit" in summary
+
+
+# ------------------------------------------- scheduler verb + piggyback
+def test_scheduler_flight_verb_and_cluster_piggyback(tmp_path, reflight):
+    reflight.setenv("WH_FLIGHT", "1")
+    reflight.setenv("WH_FLIGHT_DIR", str(tmp_path))
+    obs_flight.init_from_env()
+    sched = Scheduler(node_timeout=10)
+    sched.serve()
+    try:
+        c = SchedulerClient(sched.uri, "w0")
+        got = c.call(op="flight", reason="operator pull")
+        assert got["ok"] and got["enabled"]
+        assert got["path"] and os.path.exists(got["path"])
+        assert got["fgen"] == 1
+        lines = _dump_lines(got["path"])
+        assert lines[0]["reason"] == "operator pull"
+        # the client saw the fgen bump on the reply and dumped ITS rings
+        # too (in-process here, so both dumps share ACTIVE's node id)
+        dumps = sorted(os.listdir(tmp_path))
+        assert len(dumps) == 2
+        reasons = {_dump_lines(os.path.join(tmp_path, d))[0]["reason"]
+                   for d in dumps}
+        assert "cluster: operator pull" in reasons
+        # replies keep carrying the generation; an up-to-date client
+        # must NOT dump again
+        c.call(op="epoch")
+        assert len(os.listdir(tmp_path)) == 2
+    finally:
+        sched.stop()
+
+
+def test_scheduler_flight_verb_disabled_is_clean(reflight):
+    for k in ("WH_FLIGHT", "WH_FLIGHT_DIR"):
+        reflight.delenv(k, raising=False)
+    obs_flight.init_from_env()
+    sched = Scheduler(node_timeout=10)
+    sched.serve()
+    try:
+        c = SchedulerClient(sched.uri, "w0")
+        got = c.call(op="flight", reason="x")
+        assert got["ok"] and not got["enabled"]
+        assert got["path"] is None and got["fgen"] == 0
+        # with the recorder off the generation never moves, so ordinary
+        # replies stay free of flight fields
+        assert "fgen" not in c.call(op="epoch")
+    finally:
+        sched.stop()
+
+
+# ------------------------------------------------------------- profiler
+def test_pyprof_smoke_samples_roles_and_overhead(tmp_path, reflight):
+    reflight.setenv("WH_PROF", "1")
+    reflight.setenv("WH_PROF_HZ", "97")
+    reflight.setenv("WH_OBS_DIR", str(tmp_path))
+    p = obs_pyprof.init_from_env()
+    assert p is not None and p._thread.is_alive()
+    before = obs_metrics.REGISTRY.counter("prof.samples").value()
+    stop = threading.Event()
+
+    def spin():
+        obs_pyprof.tag_thread("train")
+        while not stop.is_set():
+            sum(range(500))
+
+    t = threading.Thread(target=spin, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while (obs_metrics.REGISTRY.counter("prof.samples").value()
+               <= before + 3 and time.monotonic() < deadline):
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        t.join()
+    assert obs_metrics.REGISTRY.counter(
+        "prof.samples").value() > before + 3
+    folded = p.folded()
+    assert folded and all(" " in line for line in folded)
+    assert any(line.startswith("train;") for line in folded), folded[:5]
+    # the overhead budget holds (throttling enforces it; generous slack
+    # for the first samples landing on a tiny wall-time denominator)
+    assert p.overhead_frac() < 5 * p.budget
+    out = p.stop()
+    assert out and os.path.exists(out)
+    assert open(out).read().splitlines() == p.folded()
+    assert not p._thread.is_alive()
+    obs_pyprof.ACTIVE = None  # stopped by hand; don't re-stop at exit
+
+
+# ------------------------------------------------------ train stage table
+def test_train_stage_table_contract():
+    r = obs_metrics.Registry()
+    stages = {"load": 0.010, "pack": 0.004, "h2d": 0.002, "step": 0.080,
+              "sync": 0.009, "metrics": 0.010}
+    for name, v in stages.items():
+        for _ in range(8):
+            r.histogram(f"train.stage.{name}_s").observe(v)
+    for _ in range(8):
+        r.histogram("train.stage.total_s").observe(0.100)
+    table = obs_report.train_stage_table(r.snapshot())
+    assert set(table["stages"]) == set(stages)
+    assert table["stages"]["step"]["p50_ms"] == pytest.approx(80.0)
+    assert table["stages"]["step"]["count"] == 8
+    assert table["total_p50_ms"] == pytest.approx(100.0)
+    # explained = load + step + metrics (pack/h2d overlap in loader
+    # threads, sync decomposes step) = 100ms of a 100ms batch
+    assert table["explained_p50_ms"] == pytest.approx(100.0)
+    assert table["explained_frac"] >= 0.9
+    # empty aggregate -> empty table, and build() only attaches it when
+    # the run actually trained
+    assert obs_report.train_stage_table({"hists": {}}) == {}
+    report = obs_report.build(r.snapshot())
+    assert report["train_stages"]["explained_frac"] >= 0.9
+    txt = "\n".join(obs_report.format_lines(report))
+    assert "train stages (p50 ms)" in txt
+    assert "explained by load+step+metrics" in txt
+    empty = obs_report.build(obs_metrics.Registry().snapshot())
+    assert "train_stages" not in empty

@@ -253,34 +253,55 @@ def test_snapshot_ring_retains_newest_in_order():
 
 def test_prometheus_exposition_golden():
     snap = {
-        "counters": {"net.bytes_sent": 17, "serve.router.requests": 3},
-        "gauges": {"slo.serve.latency_burn": 0.25},
+        "counters": {"net.bytes_sent": 17, "serve.router.requests": 3,
+                     "admit.sheds": 5, "flight.dumps": 2},
+        "gauges": {"slo.serve.latency_burn": 0.25,
+                   "prof.overhead_frac": 0.004},
         "hists": {
             "serve.latency_s": {"count": 4, "sum": 1.0, "min": 0.1,
                                 "max": 0.4, "res": [0.1, 0.2, 0.3, 0.4]},
+            "train.stage.step_s": {"count": 2, "sum": 0.5, "min": 0.2,
+                                   "max": 0.3, "res": [0.2, 0.3]},
             "never.observed_s": {"count": 0, "sum": 0.0, "res": []},
         },
     }
+
+    def _q(name, q):
+        return repr(float(obs_metrics.hist_quantile(
+            snap["hists"][name], q)))
+
     body = obs_prom.render_snapshot(snap)
     assert body == (
+        "# TYPE wh_admit_sheds_total counter\n"
+        "wh_admit_sheds_total 5\n"
+        "# TYPE wh_flight_dumps_total counter\n"
+        "wh_flight_dumps_total 2\n"
         "# TYPE wh_net_bytes_sent_total counter\n"
         "wh_net_bytes_sent_total 17\n"
         "# TYPE wh_serve_router_requests_total counter\n"
         "wh_serve_router_requests_total 3\n"
+        "# TYPE wh_prof_overhead_frac gauge\n"
+        "wh_prof_overhead_frac 0.004\n"
         "# TYPE wh_slo_serve_latency_burn gauge\n"
         "wh_slo_serve_latency_burn 0.25\n"
         "# TYPE wh_serve_latency_s summary\n"
         'wh_serve_latency_s{quantile="0.5"} '
-        + repr(float(obs_metrics.hist_quantile(
-            snap["hists"]["serve.latency_s"], 0.5))) + "\n"
+        + _q("serve.latency_s", 0.5) + "\n"
         'wh_serve_latency_s{quantile="0.9"} '
-        + repr(float(obs_metrics.hist_quantile(
-            snap["hists"]["serve.latency_s"], 0.9))) + "\n"
+        + _q("serve.latency_s", 0.9) + "\n"
         'wh_serve_latency_s{quantile="0.99"} '
-        + repr(float(obs_metrics.hist_quantile(
-            snap["hists"]["serve.latency_s"], 0.99))) + "\n"
+        + _q("serve.latency_s", 0.99) + "\n"
         "wh_serve_latency_s_sum 1.0\n"
         "wh_serve_latency_s_count 4\n"
+        "# TYPE wh_train_stage_step_s summary\n"
+        'wh_train_stage_step_s{quantile="0.5"} '
+        + _q("train.stage.step_s", 0.5) + "\n"
+        'wh_train_stage_step_s{quantile="0.9"} '
+        + _q("train.stage.step_s", 0.9) + "\n"
+        'wh_train_stage_step_s{quantile="0.99"} '
+        + _q("train.stage.step_s", 0.99) + "\n"
+        "wh_train_stage_step_s_sum 0.5\n"
+        "wh_train_stage_step_s_count 2\n"
     )
     assert obs_prom.render_snapshot({}) == ""
     assert obs_prom.prom_name("serve.stage.pack_s") == \

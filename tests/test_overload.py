@@ -127,6 +127,13 @@ def test_admission_fixed_mode_matches_inflight_gate():
 
 
 def test_admission_aimd_decays_and_regrows(monkeypatch):
+    # the growth path consults every slo.*_burn gauge in the process
+    # registry; earlier tests may have published a burning one (e.g. a
+    # run report built while the suite loaded the box), and gauges never
+    # decay — zero them so this stays a unit test of the AIMD law
+    for k in _obs.REGISTRY.snapshot().get("gauges", {}):
+        if k.startswith("slo.") and k.endswith("_burn"):
+            _obs.REGISTRY.gauge(k).set(0.0)
     monkeypatch.setenv("WH_ADMIT_MIN", "2")
     monkeypatch.setenv("WH_ADMIT_MAX", "64")
     monkeypatch.setenv("WH_ADMIT_LATENCY_MS", "50")

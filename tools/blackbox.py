@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Merge per-node flight-recorder dumps into one incident timeline.
+
+When a run crosses an SLO burn threshold, recovers a node, arms a
+fault, or is poked with the scheduler's `flight` verb, every process
+with WH_FLIGHT=1 drops its in-memory rings to
+`flight-<node>-<pid>-<seq>.jsonl` (wormhole_tpu/obs/flight.py). Each
+dump is self-contained — recent spans, per-hop deadline budgets,
+overload decisions with their recorded reasons, sampled stacks, and
+metric snapshots — but an incident spans nodes. This tool is the
+read side: it merges every dump in a directory onto one wall-clock
+axis (same clock-anchor alignment as tools/trace_viewer.py, whose
+loader it reuses) and emits both a Perfetto-compatible Chrome trace
+JSON and a text post-mortem that names each overload decision:
+
+    python tools/blackbox.py /path/to/obs_dir [-o blackbox.json]
+    python tools/blackbox.py /path/to/obs_dir --summary   # text only
+
+Truncated dumps (a process killed mid-write) lose at most their torn
+tail line; files without a clock anchor are skipped with a warning.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+# tools/ is not a package — load the sibling trace_viewer module by
+# file path so this works both as a script and under test import
+_TV_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_viewer.py")
+_spec = importlib.util.spec_from_file_location("_wh_trace_viewer", _TV_PATH)
+trace_viewer = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_viewer)
+
+
+def flight_paths(obs_dir: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(obs_dir, "flight-*.jsonl")))
+
+
+def merge_dumps(paths: list[str]) -> dict:
+    """Chrome trace dict over every flight dump, aligned on wall time.
+    Flight records use the trace-file wire format (anchor + ph X/i with
+    monotonic ts seconds), so trace_viewer's merger applies as-is."""
+    return trace_viewer.merge_traces(paths)
+
+
+def summarize(paths: list[str]) -> list[str]:
+    """Text post-mortem: one header per dump (node, trigger reason,
+    record counts) then every overload decision in wall-clock order
+    with its verdict and recorded reason."""
+    loaded = trace_viewer._load_aligned(paths)
+    if not loaded:
+        return ["[blackbox] no readable flight dumps"]
+    lines = [f"[blackbox] {len(loaded)} flight dumps"]
+    decisions = []  # (wall, node, rec)
+    t0 = min((w for _, _, ws in loaded for w in ws),
+             default=loaded[0][0]["wall"])
+    for anchor, records, walls in loaded:
+        node = f"{anchor.get('node', '?')}/{anchor.get('pid', '?')}"
+        kinds: dict[str, int] = {}
+        for r in records:
+            kinds[r.get("cat", "?")] = kinds.get(r.get("cat", "?"), 0) + 1
+        counts = " ".join(f"{k}={n}" for k, n in sorted(kinds.items()))
+        lines.append(f"  {node:<20} dumped for {anchor.get('reason', '?')!r}"
+                     f"  ({counts or 'empty'})")
+        for r, rw in zip(records, walls):
+            if r.get("cat") == "overload":
+                decisions.append((rw, node, r))
+    if decisions:
+        decisions.sort(key=lambda d: d[0])
+        lines.append("")
+        lines.append(f"overload decisions ({len(decisions)}):")
+        for rw, node, r in decisions:
+            a = r.get("args") or {}
+            extra = " ".join(f"{k}={a[k]}" for k in sorted(a)
+                             if k not in ("verdict", "reason")
+                             and a[k] is not None)
+            lines.append(
+                f"  {(rw - t0) * 1e3:10.3f} ms  {node:<20} "
+                f"{a.get('verdict', r.get('name', '?')):<16} "
+                f"{a.get('reason', '?')}" + (f"  [{extra}]" if extra else ""))
+    else:
+        lines.append("  (no overload decisions recorded)")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="blackbox",
+        description="merge flight-recorder dumps into one incident timeline")
+    ap.add_argument("obs_dir",
+                    help="directory the run dumped flight files to "
+                         "(the WH_FLIGHT_DIR / WH_OBS_DIR of the run)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="Chrome trace output path "
+                         "(default: <obs_dir>/blackbox.json)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the text post-mortem only, write nothing")
+    args = ap.parse_args(argv)
+    paths = flight_paths(args.obs_dir)
+    if not paths:
+        print(f"[blackbox] no flight-*.jsonl under {args.obs_dir}",
+              file=sys.stderr)
+        return 1
+    print("\n".join(summarize(paths)))
+    if args.summary:
+        return 0
+    merged = merge_dumps(paths)
+    out = args.out or os.path.join(args.obs_dir, "blackbox.json")
+    with open(out, "w") as fh:
+        json.dump(merged, fh)
+    n = sum(1 for e in merged["traceEvents"] if e["ph"] != "M")
+    print(f"[blackbox] {len(paths)} dumps, {n} events -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

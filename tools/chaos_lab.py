@@ -194,10 +194,16 @@ ELASTIC_SCENARIOS = [
      {"WH_HEDGE": "1"}),
     # 40ms fetches against a 20ms AIMD latency target: the gate decays
     # to WH_ADMIT_MIN and the 8 hammer threads overrun it, so bounces
-    # and deadline sheds are guaranteed, not timing luck
+    # and deadline sheds are guaranteed, not timing luck. The drill
+    # doubles as the flight-recorder acceptance: WH_FLIGHT arms the
+    # per-node rings, the 1s scrape tick lets the scheduler see the
+    # SLO burn the hammer causes, and the burn crossing triggers a
+    # cluster-wide dump that tools/blackbox.py must merge with the
+    # shed/hedge decisions named (elastic_matrix prints its summary)
     ("overload+shed", "", "net:slow@fetch:40", "overload",
      {"WH_ADMIT_AIMD": "1", "WH_ADMIT_LATENCY_MS": "20",
-      "WH_HEDGE": "1", "WH_DEADLINE_SHED": "1"}),
+      "WH_HEDGE": "1", "WH_DEADLINE_SHED": "1",
+      "WH_FLIGHT": "1", "WH_OBS_SCRAPE_SEC": "1"}),
 ]
 
 _ELASTIC_METRIC_KEYS = ("membership_epochs", "worker_joins",
@@ -313,6 +319,51 @@ def slo_error_violation(report: dict | None) -> str | None:
         if v.get("kind") == "errors" and not v.get("ok"):
             return v["name"]
     return None
+
+
+def _load_tool(name: str):
+    """Load a sibling tools/ module by file path (tools/ is not a
+    package)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_wh_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def blackbox_lines(obs_dir: str) -> list[str]:
+    """Flight-recorder post-mortem for one scenario's obs dir: merge
+    whatever flight-*.jsonl the run dumped (tools/blackbox.py) and
+    return its text summary — empty when the run dumped nothing."""
+    bb = _load_tool("blackbox")
+    paths = bb.flight_paths(obs_dir)
+    if not paths:
+        return []
+    with open(os.path.join(obs_dir, "blackbox.json"), "w") as fh:
+        json.dump(bb.merge_dumps(paths), fh)
+    return bb.summarize(paths)
+
+
+def prof_lines(obs_dir: str, top: int = 5) -> list[str]:
+    """Heaviest folded stacks across every prof-*.folded a --prof run
+    wrote into obs_dir (one file per process, obs/pyprof.py)."""
+    import glob as _glob
+
+    tally: dict[str, int] = {}
+    for path in _glob.glob(os.path.join(obs_dir, "prof-*.folded")):
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    stack, _, n = line.rstrip("\n").rpartition(" ")
+                    if stack:
+                        tally[stack] = tally.get(stack, 0) + int(n)
+        except (OSError, ValueError):
+            continue
+    heavy = sorted(tally.items(), key=lambda kv: -kv[1])[:top]
+    return [f"{n:>6}  {s}" for s, n in heavy]
 
 
 def fault_fired(out: str) -> bool:
@@ -800,6 +851,19 @@ max_delay = 1
                             for l in detail.splitlines()[1:]))
         print(f"[chaos]   metrics vs baseline: {deltas}")
         print(f"[chaos]   {slo_burn_line(report)}")
+        if (extra_env or {}).get("WH_FLIGHT"):
+            bb = blackbox_lines(os.path.join(scratch, f"obs-{i}"))
+            if bb:
+                for line in bb:
+                    print(f"[chaos]   {line}")
+            else:
+                # the drill armed the recorder but nothing dumped —
+                # the SLO-burn trigger path regressed; flag it loudly
+                print("[chaos]   flight: ARMED BUT NO DUMPS "
+                      "(SLO-burn trigger never fired?)")
+        if args.prof:
+            for line in prof_lines(os.path.join(scratch, f"obs-{i}")):
+                print(f"[chaos]   prof {line}")
 
     print(f"\n{'scenario':<22} {'verdict':<44} {'sec':>5}")
     for name, verdict, detail, dt, deltas in rows:
@@ -1153,9 +1217,19 @@ def main(argv=None) -> int:
                          "already wobble a little)")
     ap.add_argument("--timeout", type=float,
                     default=knob_value("WH_CHAOS_TIMEOUT_SEC"))
+    ap.add_argument("--prof", action="store_true",
+                    help="run every scenario with the sampling profiler "
+                         "on (WH_PROF=1, obs/pyprof.py): each process "
+                         "writes prof-*.folded into its obs dir and the "
+                         "matrix prints the heaviest stacks per scenario")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir (data + confs)")
     args = ap.parse_args(argv)
+
+    if args.prof:
+        # every run_* helper copies os.environ, so the subprocesses of
+        # all four matrices inherit the profiler arm from here
+        os.environ["WH_PROF"] = "1"
 
     if args.elastic:
         return elastic_matrix(args)
@@ -1289,6 +1363,9 @@ max_delay = 1
               f"{recov} respawns, {retries} retry events, {dt:.0f}s)")
         print(f"[chaos]   metrics vs baseline: {deltas}")
         print(f"[chaos]   {slo_burn_line(report)}")
+        if args.prof:
+            for line in prof_lines(os.path.join(scratch, f"obs-{i}")):
+                print(f"[chaos]   prof {line}")
 
     print(f"\n{'spec':<34} {'verdict':<18} {'respawns':>8} "
           f"{'retries':>8} {'sec':>5}")

@@ -31,6 +31,18 @@ _KEY_HISTS = (
     "serve.latency_s", "ps.client.rpc_s", "bsp.allreduce_s",
     "serve.stage.fanout_s", "serve.stage.score_s", "sched.barrier_wait_s",
 )
+# overload panel: shed/hedge counter rates plus the control gauges that
+# explain them (AIMD limit, hedge delay, brownout flag)
+_OVERLOAD_COUNTERS = (
+    "admit.sheds", "serve.shed.deadline", "serve.shed.busy",
+    "net.deadline.shed", "net.busy.rejections",
+    "serve.hedge.issued", "serve.hedge.wins", "serve.hedge.suppressed",
+    "serve.degraded.replies",
+)
+_OVERLOAD_GAUGES = (
+    "admit.limit", "admit.inflight",
+    "serve.hedge.delay_ms", "serve.degraded.active",
+)
 
 
 def _rates(prev: tuple | None, cur: tuple) -> dict[str, float]:
@@ -85,6 +97,23 @@ def render(got: dict, prev: tuple | None,
         lines.append("latency:")
         lines.extend(hist_lines)
     gauges = agg.get("gauges") or {}
+    counters = agg.get("counters") or {}
+    ov_lines = []
+    for name in _OVERLOAD_COUNTERS:
+        total = counters.get(name)
+        if not total:
+            continue
+        ov_lines.append(f"  {name:<32} {rates.get(name, 0.0):10.1f}/s "
+                        f"total={int(total)}")
+    for name in _OVERLOAD_GAUGES:
+        v = gauges.get(name)
+        if v is None:
+            continue
+        ov_lines.append(f"  {name:<32} {float(v):12.3f}")
+    if ov_lines:
+        lines.append("")
+        lines.append("overload (shed / hedge / brownout):")
+        lines.extend(ov_lines)
     gauge_lines = [f"  {name:<32} {float(v):12.3f}"
                    for name, v in sorted(gauges.items())]
     if gauge_lines:

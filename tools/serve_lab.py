@@ -426,9 +426,34 @@ def main(argv=None) -> int:
                          "hedging, and deadline shedding on; fails "
                          "unless goodput at 3x stays >= 80%% of "
                          "capacity with zero hard errors")
+    ap.add_argument("--prof", action="store_true",
+                    help="run with the sampling profiler on "
+                         "(obs/pyprof.py) and print the heaviest folded "
+                         "stacks + measured overhead at the end")
     ap.add_argument("--json", action="store_true",
                     help="print only the [serve-lab] machine line")
     args = ap.parse_args(argv)
+    prof = None
+    if args.prof:
+        # the import-time init already ran with WH_PROF unset; re-arm
+        from wormhole_tpu.obs import pyprof as _pyprof
+
+        os.environ["WH_PROF"] = "1"
+        prof = _pyprof.init_from_env()
+    try:
+        return _main(args)
+    finally:
+        if prof is not None:
+            print(f"[serve-lab] prof: overhead "
+                  f"{prof.overhead_frac() * 100:.2f}% "
+                  f"(budget {prof.budget * 100:.0f}%), "
+                  "heaviest stacks:", flush=True)
+            for line in prof.folded(top=8):
+                print(f"  {line}", flush=True)
+            prof.stop()
+
+
+def _main(args) -> int:
     if args.overload:
         row = overload_sweep(
             num_shards=args.shards, num_buckets=args.buckets,

@@ -287,6 +287,39 @@ declare_knob("WH_SLO_SERVE_ERR_BUDGET", float, 0.001,
 declare_knob("WH_SLO_PS_RPC_P99_MS", float, 250.0,
              "PS RPC latency SLO: p99 of ps.client.rpc_s must stay under "
              "this many milliseconds.", group="obs")
+declare_knob("WH_PROF", bool, False,
+             "Continuous sampling profiler (obs/pyprof.py): a daemon "
+             "thread samples every thread's stack at WH_PROF_HZ into "
+             "folded-stack tallies. Off = no sampler thread exists.",
+             group="obs")
+declare_knob("WH_PROF_HZ", float, 29.0,
+             "Profiler sampling rate in Hz. A prime-ish default avoids "
+             "lockstep with periodic loops.", group="obs")
+declare_knob("WH_PROF_BUDGET_PCT", float, 2.0,
+             "Profiler overhead budget as a percent of wall time; the "
+             "sampler throttles itself (skips samples) above it.",
+             group="obs")
+declare_knob("WH_FLIGHT", bool, False,
+             "Per-node flight recorder (obs/flight.py): fixed-size rings "
+             "of recent spans, overload decisions, metric snapshots, and "
+             "sampled stacks, dumped to JSONL on anomaly triggers. Off = "
+             "every hook is one None check.", group="obs")
+declare_knob("WH_FLIGHT_RING", int, 512,
+             "Flight-recorder span/hop ring capacity (records kept).",
+             group="obs")
+declare_knob("WH_FLIGHT_DECISIONS", int, 256,
+             "Flight-recorder overload-decision ring capacity.",
+             group="obs")
+declare_knob("WH_FLIGHT_SNAPS", int, 16,
+             "Flight-recorder metric-snapshot ring capacity (snapshots "
+             "sampled at most every ~5s while records flow).", group="obs")
+declare_knob("WH_FLIGHT_DIR", str, "",
+             "Directory for flight-*.jsonl dumps; empty falls back to "
+             "WH_OBS_DIR.", group="obs")
+declare_knob("WH_FLIGHT_MIN_SEC", float, 10.0,
+             "Minimum seconds between unforced flight dumps on one node "
+             "(dump storms from repeated triggers are suppressed).",
+             group="obs")
 
 # data pipeline
 declare_knob("WH_PACK_CACHE", bool, False,

@@ -106,7 +106,14 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
                                         "WH_ELASTIC_MAX",
                                         "WH_ELASTIC_PLAN",
                                         "WH_RETRY_BASE_SEC",
-                                        "WH_RETRY_CAP_SEC")) -> int:
+                                        "WH_RETRY_CAP_SEC",
+                                        "WH_PROF", "WH_PROF_HZ",
+                                        "WH_PROF_BUDGET_PCT",
+                                        "WH_FLIGHT", "WH_FLIGHT_RING",
+                                        "WH_FLIGHT_DECISIONS",
+                                        "WH_FLIGHT_SNAPS",
+                                        "WH_FLIGHT_DIR",
+                                        "WH_FLIGHT_MIN_SEC")) -> int:
     """Spawn the scheduler + N workers of `cmd`; stream their output with
     role prefixes; return the first nonzero exit code (0 if all clean).
     On scheduler exit, surviving workers are terminated (the reference

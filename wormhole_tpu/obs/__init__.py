@@ -22,6 +22,6 @@ never by `wormhole_tpu/__init__.py` — so `import wormhole_tpu` alone
 loads none of it (tests/test_obs.py pins that).
 """
 
-from wormhole_tpu.obs import metrics, report, trace  # noqa: F401
+from wormhole_tpu.obs import flight, metrics, pyprof, report, trace  # noqa: F401
 
 REGISTRY = metrics.REGISTRY

@@ -96,6 +96,12 @@ COUNTERS: dict[str, str] = {
     "elastic.spawns": "worker processes spawned by the elastic supervisor",
     "elastic.retires": "worker processes retired by the elastic supervisor",
     "ps.client.rehellos": "PSClient re-hello rounds after a membership bump",
+    "flight.records": "records accepted into the flight-recorder rings",
+    "flight.dumps": "flight-recorder dump files written",
+    "flight.dump_errors": "flight dumps that failed to write",
+    "flight.suppressed": "flight dumps suppressed by the rate limit",
+    "prof.samples": "stack sweeps taken by the sampling profiler",
+    "prof.throttled": "profiler sweeps skipped to stay under budget",
 }
 
 GAUGES: dict[str, str] = {
@@ -114,6 +120,7 @@ GAUGES: dict[str, str] = {
     "admit.inflight": "bulk requests currently admitted into handlers",
     "serve.hedge.delay_ms": "rolling-quantile hedge delay currently in force",
     "serve.degraded.active": "1 while the router serves degraded replies",
+    "prof.overhead_frac": "measured profiler overhead as a fraction of wall",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -142,6 +149,13 @@ HISTOGRAMS: dict[str, str] = {
     "kv.scatter_s": "local kvstore scatter duration",
     "perf.*_s": "utils.perf mirror of ad-hoc timed ops",
     "retry.backoff_s": "sleep durations taken between retry attempts",
+    "train.stage.load_s": "train-thread wait for the next packed batch",
+    "train.stage.pack_s": "loader-side prepare (parse + pack) per batch",
+    "train.stage.h2d_s": "loader-side host-to-device staging per batch",
+    "train.stage.step_s": "jitted train/eval step call per batch",
+    "train.stage.sync_s": "PS sync wall attributable to the train step",
+    "train.stage.metrics_s": "progress merge + printing per batch",
+    "train.stage.total_s": "train-thread wall per batch (load+step+metrics)",
 }
 
 SPANS: dict[str, str] = {

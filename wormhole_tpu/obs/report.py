@@ -66,6 +66,50 @@ def serve_stage_table(aggregate: dict) -> dict:
     return out
 
 
+#: training-step stages, in batch order. The train thread's wall per
+#: batch is load (queue wait) + step (jitted call) + metrics
+#: (merge/print) — those three are the pipeline whose p50s must sum to
+#: the per-batch total. pack and h2d run in loader threads overlapped
+#: with compute, and sync is either inside step (synchronous mode's
+#: flush) or hidden behind it (async fold wait shows up as load/step
+#: stall), so they inform but don't sum.
+TRAIN_STAGES = ("load", "pack", "h2d", "step", "sync", "metrics")
+_TRAIN_PIPELINE = ("load", "step", "metrics")
+
+
+def train_stage_table(aggregate: dict) -> dict:
+    """Per-stage training-step attribution from the train.stage.*
+    histograms — the serve_stage_table contract for the train plane:
+    {stages: {name: {p50_ms, p99_ms, mean_ms, count}}, total_p50_ms,
+    explained_p50_ms, explained_frac}. Empty when the run never
+    trained. ``explained_frac`` is the acceptance metric: the train
+    thread's pipeline stages' p50 sum over the per-batch total p50."""
+    hists = aggregate.get("hists") or {}
+    stages = {}
+    for stage in TRAIN_STAGES:
+        h = hists.get(f"train.stage.{stage}_s")
+        if not h or not h.get("count"):
+            continue
+        stages[stage] = {
+            "p50_ms": _round3(_ms(metrics.hist_quantile(h, 0.50))),
+            "p99_ms": _round3(_ms(metrics.hist_quantile(h, 0.99))),
+            "mean_ms": _round3(_ms(h["sum"] / h["count"])),
+            "count": h["count"],
+        }
+    if not stages:
+        return {}
+    out = {"stages": stages}
+    p50 = _ms(metrics.hist_quantile(
+        hists.get("train.stage.total_s"), 0.50))
+    explained = sum(stages[s]["p50_ms"] or 0.0
+                    for s in _TRAIN_PIPELINE if s in stages)
+    out["total_p50_ms"] = _round3(p50)
+    out["explained_p50_ms"] = _round3(explained)
+    out["explained_frac"] = (_round3(explained / p50)
+                             if p50 else None)
+    return out
+
+
 def enabled() -> bool:
     return bool(os.environ.get("WH_OBS_DIR", "").strip())
 
@@ -158,6 +202,9 @@ def build(aggregate: dict, nodes=(), run_id=None,
     stages = serve_stage_table(aggregate)
     if stages:
         report["serve_stages"] = stages
+    tstages = train_stage_table(aggregate)
+    if tstages:
+        report["train_stages"] = tstages
     slos = _slo.evaluate(aggregate)
     if slos:
         report["slos"] = slos
@@ -273,6 +320,17 @@ def format_lines(report: dict) -> list[str]:
                 f"  serve latency p50={stages['latency_p50_ms']:.2f}ms, "
                 f"{stages['explained_frac'] * 100:.0f}% explained by "
                 "pack+fanout+sum+score")
+    tstages = report.get("train_stages")
+    if tstages:
+        lines.append(
+            "  train stages (p50 ms): "
+            + " ".join(f"{k}={v['p50_ms']:.2f}"
+                       for k, v in tstages["stages"].items()))
+        if tstages.get("explained_frac") is not None:
+            lines.append(
+                f"  train step p50={tstages['total_p50_ms']:.2f}ms, "
+                f"{tstages['explained_frac'] * 100:.0f}% explained by "
+                "load+step+metrics")
     if report.get("slos"):
         lines.extend(_slo.format_lines(report["slos"]))
     return lines

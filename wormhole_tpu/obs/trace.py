@@ -59,6 +59,8 @@ import threading
 import time
 from typing import Optional
 
+from wormhole_tpu.obs import flight as _flight
+
 #: every SAMPLE_N-th start_request() gets a trace context (0 = off);
 #: (re)read from WH_TRACE_SAMPLE by init_from_env
 SAMPLE_N: int = 0
@@ -177,7 +179,10 @@ _NULL_SPAN = _NullSpan()
 class _Span:
     __slots__ = ("tracer", "name", "cat", "args", "t0", "_ctx", "_saved")
 
-    def __init__(self, tracer: Tracer, name: str, cat: str, args: dict):
+    def __init__(self, tracer: Optional[Tracer], name: str, cat: str,
+                 args: dict):
+        # tracer may be None: the span then only feeds the flight
+        # recorder (no file, no trace context — those need a Tracer)
         self.tracer = tracer
         self.name = name
         self.cat = cat
@@ -185,7 +190,7 @@ class _Span:
 
     def __enter__(self):
         cur = getattr(_TLS, "ctx", None)
-        if cur is not None:
+        if cur is not None and self.tracer is not None:
             sid = self.tracer.next_sid()
             self._ctx = (cur[0], sid, cur[1])
             self._saved = cur
@@ -202,8 +207,12 @@ class _Span:
             self.args = dict(self.args or {}, error=etype.__name__)
         if self._ctx is not None:
             _TLS.ctx = self._saved
-        self.tracer.emit_span(self.name, self.cat, self.t0, dur,
-                              self.args, ctx=self._ctx)
+        if self.tracer is not None:
+            self.tracer.emit_span(self.name, self.cat, self.t0, dur,
+                                  self.args, ctx=self._ctx)
+        fr = _flight.ACTIVE
+        if fr is not None:
+            fr.record_span(self.name, self.cat, self.t0, dur, self.args)
         return False
 
 
@@ -228,11 +237,12 @@ class _Bind:
 
 
 def span(name: str, cat: str = "span", **args):
-    """Context manager timing a block into the trace. When tracing is
-    off this returns a shared no-op object — no allocation, no clock
-    read — so it is safe on hot paths."""
+    """Context manager timing a block into the trace. When both tracing
+    and the flight recorder are off this returns a shared no-op object —
+    no allocation, no clock read — so it is safe on hot paths. With only
+    the flight recorder on, the span lands in its in-memory ring."""
     t = ACTIVE
-    if t is None:
+    if t is None and _flight.ACTIVE is None:
         return _NULL_SPAN
     return _Span(t, name, cat, args)
 
@@ -252,6 +262,9 @@ def event(name: str, cat: str = "event", **args) -> None:
     t = ACTIVE
     if t is not None:
         t.event(name, cat, **args)
+    fr = _flight.ACTIVE
+    if fr is not None:
+        fr.record_event(name, cat, args or None)
 
 
 def start_request() -> Optional[tuple]:

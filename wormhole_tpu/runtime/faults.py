@@ -75,6 +75,24 @@ from typing import Optional
 KILL_EXIT = 137  # the exit code of a SIGKILLed process (128 + 9)
 
 
+def _flight_note(kind: str, **detail) -> None:
+    """A fault is firing: record it into (and dump) the flight
+    recorder, so the black box names the injected cause. Imported
+    lazily at fire time — faults.py is in the bare `import wormhole_tpu`
+    closure and must not pull the obs plane in (tests/test_obs.py pins
+    that); fault ARMS are rare, so the import cost is off the hot
+    path. Kill faults dump before os._exit — an exiting process gets
+    no second chance to flush its rings."""
+    try:
+        from wormhole_tpu.obs import flight
+        if flight.ACTIVE is None:
+            return
+        flight.record_decision("fault", kind, **detail)
+        flight.dump(f"fault: {kind}", force=True)
+    except Exception:
+        pass  # the fault must fire even if the black box cannot
+
+
 class FaultSpecError(ValueError):
     pass
 
@@ -215,6 +233,7 @@ class Faults:
                     self._slow_fired = True
                     print(f"[faults] injecting net slow on {op!r} "
                           f"({d * 1000:g}ms/send)", flush=True)
+                    _flight_note("net:slow", op=op, ms=d * 1000)
                 time.sleep(d)
         if self._partitions:
             self._partition_check(op)
@@ -228,6 +247,7 @@ class Faults:
         if fire:
             print(f"[faults] injecting connection reset after "
                   f"{self._frames - 1} frames (op {op!r})", flush=True)
+            _flight_note("net:reset", op=op, frames=self._frames - 1)
             raise ConnectionResetError(
                 f"fault injected: net:reset after {self._frames - 1} frames")
 
@@ -247,6 +267,7 @@ class Faults:
                     t0 = self._partition_t0[want] = time.monotonic()
                     print(f"[faults] injecting net partition on {want!r} "
                           f"for {secs:g}s", flush=True)
+                    _flight_note("net:partition", op=want, secs=secs)
                 elapsed = time.monotonic() - t0
                 if elapsed < secs:
                     raise OSError(
@@ -272,6 +293,8 @@ class Faults:
             if n == nth:
                 print(f"[faults] server rank {self.rank} killing itself at "
                       f"{want!r} #{nth} (epoch {self.epoch})", flush=True)
+                _flight_note("server:kill", op=want, nth=nth,
+                             rank=self.rank)
                 self.kill_fn(KILL_EXIT)
 
     def worker_op(self, op) -> None:
@@ -287,6 +310,8 @@ class Faults:
             if n == nth:
                 print(f"[faults] worker rank {self.rank} killing itself at "
                       f"{want!r} #{nth} (epoch {self.epoch})", flush=True)
+                _flight_note("worker:kill", op=want, nth=nth,
+                             rank=self.rank)
                 self.kill_fn(KILL_EXIT)
 
     def sched_op(self, op) -> None:
@@ -306,9 +331,11 @@ class Faults:
             if n == nth:
                 print(f"[faults] scheduler killing itself at "
                       f"{want!r} #{nth} (epoch {self.epoch})", flush=True)
+                _flight_note("sched:kill", op=want, nth=nth)
                 self.kill_fn(KILL_EXIT)
         for want, nth in self._drops:
             if want in (op, "any") and n_op == nth:
+                _flight_note("sched:drop", op=op, nth=nth)
                 raise ConnectionError(
                     f"fault injected: sched:drop {op!r} #{nth}")
 

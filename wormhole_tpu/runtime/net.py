@@ -45,6 +45,7 @@ from typing import Optional
 
 import numpy as np
 
+from wormhole_tpu.obs import flight as _flight
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
@@ -279,6 +280,9 @@ def recv_frame(sock_file) -> Optional[tuple[dict, dict[str, np.ndarray], int]]:
     header = json.loads(h)
     decode_s = time.perf_counter() - t0
     _overload.arm(header)  # anchor a carried deadline: dl -> dl_mono
+    if _flight.ACTIVE is not None and header.get("dl") is not None:
+        # per-hop deadline audit: budget this frame arrived with
+        _flight.record_hop(header.get("op"), float(header["dl"]))
     total = 4 + hlen
     arrays = {}
     for m in header.get("arrays", []):

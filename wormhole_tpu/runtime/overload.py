@@ -55,6 +55,7 @@ import time
 from typing import Optional
 
 from wormhole_tpu.config import knob_value
+from wormhole_tpu.obs import flight as _flight
 from wormhole_tpu.obs import metrics as _obs
 
 _DEADLINE_SHED = _obs.REGISTRY.counter("net.deadline.shed")
@@ -173,6 +174,9 @@ def should_shed(header: dict) -> bool:
     if not knob_value("WH_DEADLINE_SHED"):
         return False
     _DEADLINE_SHED.inc()
+    _flight.record_decision(
+        "shed", "deadline expired in transit", op=header.get("op"),
+        budget_ms=round((d - time.monotonic()) * 1e3, 3))
     return True
 
 
@@ -268,6 +272,10 @@ class AdmissionController:
                 self._hit_limit = True
                 self._BUSY_REJECTIONS.inc()
                 _ADMIT_SHEDS.inc()
+                _flight.record_decision(
+                    "admit_shed",
+                    f"inflight {self._inflight} >= limit {self.limit}",
+                    op=op)
                 return False
             self._inflight += 1
             self._reject_streak = 0
@@ -421,8 +429,11 @@ class HedgeTracker:
                 self._issued += 1
         if allowed:
             _HEDGE_ISSUED.inc()
+            _flight.record_decision("hedge", "delay quantile elapsed")
         else:
             _HEDGE_SUPPRESSED.inc()
+            _flight.record_decision("hedge_suppressed",
+                                    "hedge budget spent")
         return allowed
 
     @staticmethod
@@ -430,6 +441,7 @@ class HedgeTracker:
         """The backup answered first (the shard reply cache absorbed
         the duplicate — see router._attempt)."""
         _HEDGE_WINS.inc()
+        _flight.record_decision("hedge_win", "backup answered first")
 
 
 def hedge_tracker() -> Optional[HedgeTracker]:
@@ -491,6 +503,10 @@ class DegradeController:
                     self._active = True
                     _DEGRADED_ENTERS.inc()
                     _DEGRADED_ACTIVE.set(1.0)
+                    _flight.record_decision(
+                        "brownout_enter",
+                        f"burn {burn:.1f} > {self.burn_thr:.1f} "
+                        f"for {self.after_s:.0f}s")
             else:
                 self._over_since = None
                 if self._under_since is None:
@@ -500,6 +516,9 @@ class DegradeController:
                     self._active = False
                     _DEGRADED_EXITS.inc()
                     _DEGRADED_ACTIVE.set(0.0)
+                    _flight.record_decision(
+                        "brownout_exit",
+                        f"burn clear for {self.clear_s:.0f}s")
 
     def observe(self, latency_s: float) -> None:
         if self.enabled:

@@ -74,6 +74,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import pyprof as _pyprof
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime import overload as _overload
@@ -112,6 +113,10 @@ _SYNC_PULL_S = _obs.REGISTRY.histogram("ps.client.sync_pull_s")
 _SYNC_INFLIGHT = _obs.REGISTRY.gauge("ps.sync.inflight")
 _SYNC_OVERLAP = _obs.REGISTRY.gauge("ps.sync.overlap_frac")
 _SYNC_WAIT_S = _obs.REGISTRY.histogram("ps.client.sync_wait_s")
+# train.stage.* mirror: the sync wall the TRAIN THREAD actually pays —
+# the full round-trip in synchronous mode, only the fold wait in async
+# mode (the overlapped remainder is hidden behind compute)
+_ST_SYNC = _obs.REGISTRY.histogram("train.stage.sync_s")
 # key-list caching (the KEY_CACHING filter analog): hits = frames that
 # shipped digest-only, misses = digest sends the receiver couldn't
 # resolve (followed by a full resend), invalidations = cache discards
@@ -2039,6 +2044,7 @@ class SyncedStore:
         pull) against the servers. PSClient is touched ONLY from this
         thread while async mode is live, so the fenced retry / journal
         replay / rollback machinery runs here unchanged."""
+        _pyprof.tag_thread("comms")
         while True:
             job = self._comm_q.get()
             if job is None:
@@ -2100,6 +2106,7 @@ class SyncedStore:
                 self.perf.add("ps_push", job["push_s"])
                 self.perf.add("ps_pull", job["pull_s"])
         _SYNC_WAIT_S.observe(waited)
+        _ST_SYNC.observe(waited)
         if self._rt_wall > 0:
             _SYNC_OVERLAP.set(
                 max(0.0, 1.0 - self._wait_wall / self._rt_wall))
@@ -2204,6 +2211,7 @@ class SyncedStore:
         t2 = time.perf_counter()
         _SYNC_PUSH_S.observe(t1 - t0)
         _SYNC_PULL_S.observe(t2 - t1)
+        _ST_SYNC.observe(t2 - t0)
         _SYNCS.inc()
         self._push_s += t1 - t0
         self._pull_s += t2 - t1

@@ -35,6 +35,7 @@ from enum import Enum
 from typing import Optional
 
 from wormhole_tpu.config import knob_value
+from wormhole_tpu.obs import flight as _flight
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import prom as _prom
 from wormhole_tpu.obs import slo as _slo
@@ -214,6 +215,14 @@ class Scheduler:
         # its dead incarnation's — surviving-incarnation semantics, same
         # as PSClient.stats())
         self._node_metrics: dict[str, dict] = {}
+        # flight-recorder control plane: a trigger bumps _flight_gen and
+        # every subsequent RPC reply carries it (fgen/fwhy), so clients
+        # dump their own rings around the same moment — the multi-node
+        # black box. _burning_slos tracks which SLOs were already over
+        # budget so only fresh crossings trigger (scrape thread only).
+        self._flight_gen = 0
+        self._flight_why = ""
+        self._burning_slos: set[str] = set()
         self.num_server_recoveries = 0           # servers that re-registered
         self._done = False
         self._stop_evt = threading.Event()
@@ -337,6 +346,7 @@ class Scheduler:
             _trace.event("sched.resumed", cat="recovery",
                          inc=self.incarnation, records=len(records),
                          snapshot=snap is not None)
+            self._flight_trigger(f"sched.resumed inc={self.incarnation}")
             print(f"[recovery] scheduler resumed at incarnation "
                   f"{self.incarnation} (snapshot="
                   f"{'yes' if snap else 'no'}, {len(records)} journal "
@@ -709,6 +719,13 @@ class Scheduler:
                                 "inc": self.incarnation}
             resp = self._dispatch_op(op, req)
             resp["inc"] = self.incarnation
+            if self._flight_gen:
+                # piggyback the flight generation + trigger reason so
+                # every client learns of a cluster trigger on its next
+                # RPC (heartbeats flow constantly) and dumps its rings
+                with self._lock:
+                    resp["fgen"] = self._flight_gen
+                    resp["fwhy"] = self._flight_why
             if sender is not None and seq is not None:
                 self._record_op(op, req, resp, sender, seq)
             return resp
@@ -818,6 +835,7 @@ class Scheduler:
                 _SRV_RECOVERIES.inc()
                 _trace.event("sched.server_recovered", cat="recovery",
                              rank=rank, uri=req["uri"], prev=prev)
+                self._flight_trigger(f"server-{rank} recovered")
                 print(f"[recovery] ps server-{rank} re-registered at "
                       f"{req['uri']} (was {prev})", flush=True)
             return {"ok": True}
@@ -838,6 +856,7 @@ class Scheduler:
                 _SERVE_RECOVERIES.inc()
                 _trace.event("sched.serve_recovered", cat="recovery",
                              rank=rank, uri=req["uri"], prev=prev)
+                self._flight_trigger(f"serve-shard-{rank} recovered")
                 print(f"[recovery] serve shard-{rank} re-registered at "
                       f"{req['uri']} (was {prev})", flush=True)
             return {"ok": True}
@@ -879,6 +898,7 @@ class Scheduler:
                 _BSP_RECOVERIES.inc()
                 _trace.event("sched.bsp_recovered", cat="recovery",
                              rank=rank, uri=req["uri"], prev=prev)
+                self._flight_trigger(f"bsp-worker-{rank} recovered")
                 print(f"[recovery] bsp worker-{rank} re-registered at "
                       f"{req['uri']} (was {prev}); generation -> {gen}",
                       flush=True)
@@ -1042,6 +1062,15 @@ class Scheduler:
             with self._lock:
                 gen = self._barrier_gen.get(req["name"], 0)
             return {"released": gen > req["gen"]}
+        if op == "flight":
+            # explicit black-box dump: dump this node's rings NOW and
+            # bump the generation so every client dumps on its next RPC
+            reason = str(req.get("reason") or "flight-verb")
+            path = self._flight_trigger(reason)
+            with self._lock:
+                gen = self._flight_gen
+            return {"ok": True, "enabled": _flight.ACTIVE is not None,
+                    "path": path, "fgen": gen}
         return {"error": f"unknown op {op!r}"}
 
     def _barrier_enter(self, name: str, node: str, world: int) -> dict:
@@ -1147,17 +1176,39 @@ class Scheduler:
         self._threads.append(t)
 
     # -- telemetry ----------------------------------------------------------
+    def _flight_trigger(self, reason: str) -> Optional[str]:
+        """An anomaly fired: dump this node's flight rings and bump the
+        generation every RPC reply piggybacks, so the whole cluster
+        dumps its recent past around the same moment. No-op (and no
+        generation bump — replies stay byte-identical) when the flight
+        recorder is disabled."""
+        if _flight.ACTIVE is None:
+            return None
+        with self._lock:
+            self._flight_gen += 1
+            self._flight_why = reason
+        return _flight.dump(reason, force=True)
+
     def _scrape_loop(self) -> None:  # wormlint: thread-entry
         """WH_OBS_SCRAPE_SEC sampler: append the aggregated cluster
         snapshot to the ring every tick (metrics over time, not just
         final values) and refresh the slo.*_burn gauges so burn rates
-        ride heartbeats and scrapes like any other metric."""
+        ride heartbeats and scrapes like any other metric. A FRESH
+        SLO-burn crossing (an objective newly over budget this tick)
+        triggers a cluster-wide flight dump."""
         while not self._stop_evt.wait(self._scrape_sec):
             try:
                 got = self.aggregate_metrics()
             except Exception:
                 continue  # a malformed node snapshot must not kill it
-            _slo.evaluate(got["aggregate"])
+            slos = _slo.evaluate(got["aggregate"])
+            burning = {v["name"] for v in slos if not v.get("ok", True)}
+            with self._lock:
+                fresh = burning - self._burning_slos
+                self._burning_slos = burning
+            if fresh:
+                self._flight_trigger(
+                    "slo-burn: " + ",".join(sorted(fresh)))
             self._snap_ring.add(time.time(), got["aggregate"])
             _RING_DEPTH.set(float(len(self._snap_ring)))
 
@@ -1258,6 +1309,8 @@ class Scheduler:
         """Evict one node that dropped off the liveness plane (shared
         between the watchdog and journal replay of `evict` records)."""
         _trace.event("sched.liveness_evict", cat="recovery", node=n)
+        if not self._replaying:
+            self._flight_trigger(f"liveness-evict {n}")
         if n.startswith("server"):
             # servers carry no pool parts; their loss is its own
             # first-class event (the launcher's respawn loop — if
@@ -1334,6 +1387,7 @@ class SchedulerClient:
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._inc: Optional[int] = None  # last incarnation seen
+        self._fgen = 0  # last flight generation seen (fgen piggyback)
 
     def call(self, **req) -> dict:
         """One exactly-once RPC. Connection establishment always
@@ -1388,6 +1442,18 @@ class SchedulerClient:
                 print(f"[sched-client] {self.node}: scheduler restarted "
                       f"(incarnation {prev} -> {inc}); resumed from its "
                       "journal", flush=True)
+        fgen = resp.get("fgen")
+        if fgen is not None:
+            # cluster flight trigger: the scheduler bumped the flight
+            # generation — dump THIS node's rings too (multi-node black
+            # box; a no-op when the local recorder is off)
+            with self._seq_lock:
+                fresh_gen = int(fgen) > self._fgen
+                if fresh_gen:
+                    self._fgen = int(fgen)
+            if fresh_gen:
+                _flight.dump(f"cluster: {resp.get('fwhy') or '?'}",
+                             force=True)
         if "error" in resp:
             raise RuntimeError(f"scheduler error: {resp['error']}")
         return resp

@@ -39,6 +39,7 @@ import numpy as np
 
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
+from wormhole_tpu.obs import pyprof as _pyprof
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime import overload as _overload
@@ -266,6 +267,7 @@ class ModelServer:
 
     # -- hot swap -----------------------------------------------------------
     def _watch_loop(self) -> None:  # wormlint: thread-entry
+        _pyprof.tag_thread("watcher")
         while not self._shutdown.wait(self.poll_sec):
             try:
                 self.maybe_swap()

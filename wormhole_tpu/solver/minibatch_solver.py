@@ -26,6 +26,7 @@ import numpy as np
 
 from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.data import pack_cache as _pc
+from wormhole_tpu.obs import pyprof as _pyprof
 from wormhole_tpu.obs import report as _report
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.obs.metrics import REGISTRY
@@ -171,6 +172,18 @@ class MembershipController:
 _QDEPTH = REGISTRY.gauge("queue.depth")
 _STALL = REGISTRY.gauge("loader.stall_s")
 _POOL = REGISTRY.gauge("loader.pool_size")
+
+# training-step stage decomposition (the serve.stage.* contract for
+# the train plane — obs/report.train_stage_table): the train thread's
+# wall per batch splits into load (queue wait) + step (jitted call) +
+# metrics (merge/print); pack and h2d run in loader threads overlapped
+# with compute, and sync_s is observed by the PS client sync paths.
+_ST_LOAD = REGISTRY.histogram("train.stage.load_s")
+_ST_PACK = REGISTRY.histogram("train.stage.pack_s")
+_ST_H2D = REGISTRY.histogram("train.stage.h2d_s")
+_ST_STEP = REGISTRY.histogram("train.stage.step_s")
+_ST_METRICS = REGISTRY.histogram("train.stage.metrics_s")
+_ST_TOTAL = REGISTRY.histogram("train.stage.total_s")
 
 
 class MinibatchSolver:
@@ -345,6 +358,7 @@ class MinibatchSolver:
                  if self.device_feed else None)
 
         def loader(node_id: int):
+            _pyprof.tag_thread("loader")
             try:
                 while not stop.is_set():
                     got = pool.get(f"loader-{node_id}")
@@ -369,8 +383,12 @@ class MinibatchSolver:
                         # overlapped with the main thread's device steps
                         if prepare is None:
                             return blk
+                        t0p = time.perf_counter()
                         with self.perf.timer("prepare"):
-                            return prepare(blk, train=train)
+                            out = prepare(blk, train=train)
+                        if train:
+                            _ST_PACK.observe(time.perf_counter() - t0p)
+                        return out
 
                     # identical (token, part, file bytes, batch geometry)
                     # => identical pack; anything else misses
@@ -383,7 +401,11 @@ class MinibatchSolver:
                     for b in _pc.iter_part_cached(
                             self.pack_cache, part_key, raw_iter, prep):
                         if stage is not None:
+                            t0h = time.perf_counter()
                             b = stage(b, train=train)
+                            if train:
+                                _ST_H2D.observe(
+                                    time.perf_counter() - t0h)
                         if not _put(b):
                             return
                     pool.finish(part_id)
@@ -414,6 +436,7 @@ class MinibatchSolver:
         gets = 0
         high = 0
         t_pass0 = time.perf_counter()
+        _pyprof.tag_thread("train")
         if self.verbose:
             self._log(f"{mode} pass {data_pass}: {data}")
             self._log(Progress.header())
@@ -437,15 +460,23 @@ class MinibatchSolver:
                         continue
                     t_s = time.perf_counter()
                     with _trace.span(f"solver.{mode}_step", cat="solver"):
-                        prog.merge(step(item))
+                        out = step(item)
                     dt = time.perf_counter() - t_s
                     self.perf.add(f"{mode}_step", dt)
                     t_step += dt
                     n_steps += 1
+                    t_m = time.perf_counter()
+                    prog.merge(out)
                     if self.verbose \
                             and time.time() - last_print >= cfg.print_sec:
                         self._log(prog.row(self.t0))
                         last_print = time.time()
+                    if train:
+                        dm = time.perf_counter() - t_m
+                        _ST_LOAD.observe(dw)
+                        _ST_STEP.observe(dt)
+                        _ST_METRICS.observe(dm)
+                        _ST_TOTAL.observe(dw + dt + dm)
         finally:
             stop.set()
             for t in threads:
