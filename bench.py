@@ -609,7 +609,9 @@ def emit_bsp():
              r.pop("allreduce_ms"), "ms", **r)
 
 
-def bench_serve(num_shards=2, num_buckets=1 << 26, duration_s=12.0):
+def bench_serve(num_shards=2, num_buckets=1 << 26, duration_s=12.0,
+                serve_mode="fetch", concurrency=4,
+                price_tracing=False):
     """The serving tier at Criteo-1TB table scale: 2 in-process shards
     each holding half the 64M-bucket w table, a router scoring
     closed-loop predict batches through them, and a snapshot writer
@@ -617,7 +619,13 @@ def bench_serve(num_shards=2, num_buckets=1 << 26, duration_s=12.0):
     request-visible stall (tools/serve_lab.py is the harness; this is
     its bench operating point). The window is sized so a full 256 MB
     set write (~2 s) + the watcher's slice load lands well inside it —
-    a 6 s run clocked zero in-window swaps."""
+    a 6 s run clocked zero in-window swaps.
+
+    serve_mode picks the dataflow: "fetch" pulls weight slices to the
+    router (the PR-13 anchor), "score" runs shard-local scoring with
+    router micro-batching (the fast path). Either way the run fails
+    here if the stage table explains < 90% of request p50 — a silent
+    attribution gap is a bench regression, not a footnote."""
     import os
     import shutil
     import tempfile
@@ -627,56 +635,87 @@ def bench_serve(num_shards=2, num_buckets=1 << 26, duration_s=12.0):
 
     row = serve_run(num_shards=num_shards, num_buckets=num_buckets,
                     minibatch=1000, nnz=64, duration_s=duration_s,
-                    concurrency=4, swap_every_s=2.0,
-                    verbose=False)
-    # price the tracing plane: the same load with spans sampled 1 in 64
-    # into a scratch WH_OBS_DIR, vs the tracing-off run above. The
-    # overhead lands in the row so a regression shows up as a number.
-    obs_dir = tempfile.mkdtemp(prefix="wh_bench_obs_")
-    saved = {k: os.environ.get(k) for k in ("WH_OBS_DIR",
-                                            "WH_TRACE_SAMPLE")}
-    os.environ["WH_OBS_DIR"] = obs_dir
-    os.environ["WH_TRACE_SAMPLE"] = "64"
-    obs_trace.init_from_env()
-    try:
-        traced = serve_run(num_shards=num_shards, num_buckets=num_buckets,
-                           minibatch=1000, nnz=64, duration_s=duration_s,
-                           concurrency=4, swap_every_s=2.0,
-                           seed=1, verbose=False)
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+                    concurrency=concurrency, swap_every_s=2.0,
+                    serve_mode=serve_mode, verbose=False)
+    if price_tracing:
+        # price the tracing plane: the same load with spans sampled 1
+        # in 64 into a scratch WH_OBS_DIR, vs the tracing-off run
+        # above. The overhead lands in the row so a regression shows
+        # up as a number.
+        obs_dir = tempfile.mkdtemp(prefix="wh_bench_obs_")
+        saved = {k: os.environ.get(k) for k in ("WH_OBS_DIR",
+                                                "WH_TRACE_SAMPLE")}
+        os.environ["WH_OBS_DIR"] = obs_dir
+        os.environ["WH_TRACE_SAMPLE"] = "64"
         obs_trace.init_from_env()
-        shutil.rmtree(obs_dir, ignore_errors=True)
-    row["qps_traced_1_in_64"] = round(traced["qps"], 1)
-    row["obs_overhead_pct"] = round(
-        (1.0 - traced["qps"] / row["qps"]) * 100.0, 2) if row["qps"] \
-        else None
+        try:
+            traced = serve_run(
+                num_shards=num_shards, num_buckets=num_buckets,
+                minibatch=1000, nnz=64, duration_s=duration_s,
+                concurrency=concurrency, swap_every_s=2.0,
+                serve_mode=serve_mode, seed=1, verbose=False)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            obs_trace.init_from_env()
+            shutil.rmtree(obs_dir, ignore_errors=True)
+        row["qps_traced_1_in_64"] = round(traced["qps"], 1)
+        row["obs_overhead_pct"] = round(
+            (1.0 - traced["qps"] / row["qps"]) * 100.0, 2) if row["qps"] \
+            else None
+    frac = row.get("stage_explained_frac")
+    if frac is not None and frac < 0.9:
+        raise AssertionError(
+            f"serve[{serve_mode}] stage table explains only "
+            f"{frac:.2f} of request p50 (floor 0.90) — a stage is "
+            "missing from the attribution")
     return row
 
 
-def emit_serve():
-    row = _safe("serve", bench_serve)
-    if row is None:
-        return
+def _serve_row_kw(row):
     stage_kw = {f"{st}_ms": row[f"{st}_ms"]
-                for st in ("pack", "fanout", "wire", "queue", "score",
-                           "sum") if row.get(f"{st}_ms") is not None}
-    emit("linear_ftrl_serve_64m_buckets", round(row["qps"], 1), "qps",
-         p50_ms=round(row["p50_ms"], 3), p99_ms=round(row["p99_ms"], 3),
-         p999_ms=round(row["p999_ms"], 3),
-         shards=row["shards"], concurrency=row["concurrency"],
-         requests=row["requests"], errors=row["errors"],
-         swap_count=row["swap_count"],
-         swap_stall_ms=round(row["swap_stall_ms"], 3),
-         epoch_retries=row["epoch_retries"],
-         stage_explained_frac=row.get("stage_explained_frac"),
-         qps_traced_1_in_64=row.get("qps_traced_1_in_64"),
-         obs_overhead_pct=row.get("obs_overhead_pct"),
-         **stage_kw)
+                for st in ("batch_wait", "pack", "fanout", "wire",
+                           "queue", "partial", "score", "sum")
+                if row.get(f"{st}_ms") is not None}
+    return dict(
+        p50_ms=round(row["p50_ms"], 3), p99_ms=round(row["p99_ms"], 3),
+        p999_ms=round(row["p999_ms"], 3),
+        serve_mode=row["serve_mode"],
+        shards=row["shards"], concurrency=row["concurrency"],
+        requests=row["requests"], errors=row["errors"],
+        swap_count=row["swap_count"],
+        swap_stall_ms=round(row["swap_stall_ms"], 3),
+        epoch_retries=row["epoch_retries"],
+        stage_explained_frac=row.get("stage_explained_frac"),
+        qps_traced_1_in_64=row.get("qps_traced_1_in_64"),
+        obs_overhead_pct=row.get("obs_overhead_pct"),
+        **stage_kw)
+
+
+def emit_serve():
+    # the fetch anchor: the pull-the-weights dataflow at its recorded
+    # operating point (the PERF.md 79.7 qps row came from here)
+    fetch = _safe("serve_fetch", bench_serve, serve_mode="fetch")
+    # the score fast path: closed-loop round size tracks concurrency,
+    # so drive it at 32 to give the micro-batcher real rounds
+    score = _safe("serve_score", bench_serve, serve_mode="score",
+                  concurrency=32, price_tracing=True)
+    if fetch is not None:
+        emit("linear_ftrl_serve_64m_buckets", round(fetch["qps"], 1),
+             "qps", **_serve_row_kw(fetch))
+    if score is not None:
+        # vs_baseline = speedup over the fetch anchor on the same box
+        emit("linear_ftrl_serve_64m_buckets_score",
+             round(score["qps"], 1), "qps",
+             vs_baseline=(score["qps"] / fetch["qps"]
+                          if fetch and fetch["qps"] else None),
+             batch_rounds=score.get("batch_rounds"),
+             batch_mean_size=round(score.get("batch_mean_size") or 0.0,
+                                   1),
+             **_serve_row_kw(score))
 
 
 def _safe(what, fn, *args, **kw):

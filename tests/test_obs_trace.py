@@ -254,10 +254,13 @@ def test_snapshot_ring_retains_newest_in_order():
 def test_prometheus_exposition_golden():
     snap = {
         "counters": {"net.bytes_sent": 17, "serve.router.requests": 3,
-                     "admit.sheds": 5, "flight.dumps": 2},
+                     "admit.sheds": 5, "flight.dumps": 2,
+                     "serve.batch.rounds": 9},
         "gauges": {"slo.serve.latency_burn": 0.25,
                    "prof.overhead_frac": 0.004},
         "hists": {
+            "serve.batch.size": {"count": 3, "sum": 12.0, "min": 1.0,
+                                 "max": 8.0, "res": [1.0, 3.0, 8.0]},
             "serve.latency_s": {"count": 4, "sum": 1.0, "min": 0.1,
                                 "max": 0.4, "res": [0.1, 0.2, 0.3, 0.4]},
             "train.stage.step_s": {"count": 2, "sum": 0.5, "min": 0.2,
@@ -278,12 +281,23 @@ def test_prometheus_exposition_golden():
         "wh_flight_dumps_total 2\n"
         "# TYPE wh_net_bytes_sent_total counter\n"
         "wh_net_bytes_sent_total 17\n"
+        "# TYPE wh_serve_batch_rounds_total counter\n"
+        "wh_serve_batch_rounds_total 9\n"
         "# TYPE wh_serve_router_requests_total counter\n"
         "wh_serve_router_requests_total 3\n"
         "# TYPE wh_prof_overhead_frac gauge\n"
         "wh_prof_overhead_frac 0.004\n"
         "# TYPE wh_slo_serve_latency_burn gauge\n"
         "wh_slo_serve_latency_burn 0.25\n"
+        "# TYPE wh_serve_batch_size summary\n"
+        'wh_serve_batch_size{quantile="0.5"} '
+        + _q("serve.batch.size", 0.5) + "\n"
+        'wh_serve_batch_size{quantile="0.9"} '
+        + _q("serve.batch.size", 0.9) + "\n"
+        'wh_serve_batch_size{quantile="0.99"} '
+        + _q("serve.batch.size", 0.99) + "\n"
+        "wh_serve_batch_size_sum 12.0\n"
+        "wh_serve_batch_size_count 3\n"
         "# TYPE wh_serve_latency_s summary\n"
         'wh_serve_latency_s{quantile="0.5"} '
         + _q("serve.latency_s", 0.5) + "\n"

@@ -265,8 +265,9 @@ class _StubHedge:
 
 
 class _StallFirstFetchGate:
-    """Admission gate that stalls the FIRST fetch inside the handler —
-    the deterministic straggler a hedge exists to cut past."""
+    """Admission gate that stalls the FIRST data-plane request (fetch
+    or score) inside the handler — the deterministic straggler a hedge
+    exists to cut past."""
 
     def __init__(self, stall_s):
         self.stall_s = stall_s
@@ -274,7 +275,7 @@ class _StallFirstFetchGate:
         self._stalled = False
 
     def try_enter(self, op=None):
-        if op == "fetch":
+        if op in ("fetch", "score"):
             with self._lock:
                 first = not self._stalled
                 self._stalled = True

@@ -82,10 +82,13 @@ def test_torn_snapshot_detected(tmp_path):
 
 
 # ------------------------------------------------- bit-exact sharded predict
-def test_linear_serving_bitmatch_and_hot_swap(tmp_path):
+@pytest.mark.parametrize("mode", ["fetch", "score"])
+def test_linear_serving_bitmatch_and_hot_swap(tmp_path, mode):
     """The tier-1 e2e: train a small linear model, snapshot it, serve it
     from 2 shards through the router, and the scores BIT-match the
-    trainer's own predict; then a newer snapshot hot-swaps in."""
+    trainer's own predict — on BOTH dataflows (row-fetch fallback and
+    the shard-local score fast path); then a newer snapshot hot-swaps
+    in."""
     rng = np.random.default_rng(0)
     cfg = LinearConfig(minibatch=64, num_buckets=1 << 12, nnz_per_row=16)
     # 1x1 mesh: the scorer mirrors the trainer's SINGLE-DEVICE predict
@@ -101,7 +104,9 @@ def test_linear_serving_bitmatch_and_hot_swap(tmp_path):
     tables = {k: np.asarray(v) for k, v in learner.store.state.items()}
     v1 = _manifest.write_snapshot_set(base, tables, world=2)
     servers = _serve_group(base, 2)
-    router = Router([s.uri for s in servers], LinearScorer(cfg))
+    router = Router([s.uri for s in servers], LinearScorer(cfg),
+                    mode=mode)
+    assert router.mode == mode
     try:
         blk = _blk(rng, n=50)
         scores, version = router.predict_block(blk)
@@ -126,7 +131,12 @@ def test_linear_serving_bitmatch_and_hot_swap(tmp_path):
             s.stop()
 
 
-def test_difacto_serving_bitmatch(tmp_path):
+@pytest.mark.parametrize("mode", ["fetch", "score"])
+def test_difacto_serving_bitmatch(tmp_path, mode):
+    """Fetch mode reproduces the trainer's margins bit for bit. Score
+    mode holds the documented contract instead: the linear term is
+    bit-exact but the FM quadratic term's cross-shard reassociation
+    (docs/serving.md) can move a margin by a few ulp."""
     rng = np.random.default_rng(1)
     cfg = DifactoConfig(minibatch=64, num_buckets=1 << 10,
                         nnz_per_row=16, dim=4, threshold=2)
@@ -146,21 +156,29 @@ def test_difacto_serving_bitmatch(tmp_path):
          "V": np.asarray(learner.vstore.state["V"])},
         world=3)
     servers = _serve_group(base, 3)
-    router = Router([s.uri for s in servers], DifactoScorer(cfg))
+    router = Router([s.uri for s in servers], DifactoScorer(cfg),
+                    mode=mode)
     try:
         blk = _blk(rng, n=40)
         scores, _ = router.predict_block(blk)
         ref = np.asarray(learner.predict_batch(blk))
-        assert np.array_equal(scores, ref[:40])
+        if mode == "fetch":
+            assert np.array_equal(scores, ref[:40])
+        else:
+            np.testing.assert_allclose(scores, ref[:40],
+                                       rtol=1e-5, atol=1e-6)
     finally:
         router.close()
         for s in servers:
             s.stop()
 
 
-def test_router_world_sizes_agree(tmp_path):
+@pytest.mark.parametrize("mode", ["fetch", "score"])
+def test_router_world_sizes_agree(tmp_path, mode):
     """The serve world is a deployment choice: 1-shard and 3-shard
-    groups over the same snapshot produce identical bits."""
+    groups over the same snapshot produce identical bits (linear's
+    per-nonzero partial products fold in original order regardless of
+    which shard computed them)."""
     rng = np.random.default_rng(2)
     cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
     base = str(tmp_path / "srv")
@@ -171,7 +189,8 @@ def test_router_world_sizes_agree(tmp_path):
     got = {}
     for world in (1, 3):
         servers = _serve_group(base, world)
-        router = Router([s.uri for s in servers], LinearScorer(cfg))
+        router = Router([s.uri for s in servers], LinearScorer(cfg),
+                        mode=mode)
         try:
             got[world], _ = router.predict_block(blk)
         finally:
@@ -182,10 +201,14 @@ def test_router_world_sizes_agree(tmp_path):
 
 
 # ------------------------------------------------------- swap under load
-def test_hot_swap_under_load_no_mixed_versions(tmp_path):
+@pytest.mark.parametrize("mode", ["fetch", "score"])
+def test_hot_swap_under_load_no_mixed_versions(tmp_path, mode):
     """Concurrent predicts while snapshots keep swapping: every batch's
     scores must match the version its reply claims — no drops, no
-    mixed-version batches."""
+    mixed-version batches. In score mode this also pins the replay
+    contract for COALESCED rounds: a micro-batch whose fan-out
+    straddles a swap replays whole, so every member sees one
+    version."""
     rng = np.random.default_rng(3)
     cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
     base = str(tmp_path / "srv")
@@ -194,7 +217,8 @@ def test_hot_swap_under_load_no_mixed_versions(tmp_path):
         base, {"w": np.full(cfg.num_buckets, 1.0, np.float32)}, world=2)
     versions[v] = 1.0
     servers = _serve_group(base, 2, poll_sec=0.02)
-    router = Router([s.uri for s in servers], LinearScorer(cfg))
+    router = Router([s.uri for s in servers], LinearScorer(cfg),
+                    mode=mode)
     scorer = LinearScorer(cfg)
     blocks = [_blk(rng, n=32) for _ in range(4)]
     results, errors = [], []
@@ -320,6 +344,101 @@ def test_busy_bounce_is_retried_and_exactly_once(tmp_path):
         sock.close()
     finally:
         router.close()
+        server.stop()
+
+
+# --------------------------------------------------- score-mode fast path
+def test_score_mode_micro_batch_coalesces(tmp_path, monkeypatch):
+    """Concurrent predicts coalesce into shared score rounds under a
+    linger budget, and every member still gets the bit-exact margins
+    it would have gotten solo."""
+    monkeypatch.setenv("WH_SERVE_BATCH_WAIT_MS", "20")
+    rng = np.random.default_rng(5)
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 10, nnz_per_row=8)
+    base = str(tmp_path / "srv")
+    w = rng.normal(size=cfg.num_buckets).astype(np.float32)
+    _manifest.write_snapshot_set(base, {"w": w}, world=2)
+    servers = _serve_group(base, 2)
+    scorer = LinearScorer(cfg)
+    router = Router([s.uri for s in servers], scorer, mode="score")
+    blocks = [_blk(rng, n=24) for _ in range(8)]
+    expected = []
+    for b in blocks:
+        packed = scorer.pack(b)
+        expected.append(scorer.score(
+            packed, {"w": w[packed.keys["w"]]}))
+    rounds0 = _obs.REGISTRY.counter("serve.batch.rounds").value()
+    coal0 = _obs.REGISTRY.counter("serve.batch.coalesced").value()
+    results = [None] * len(blocks)
+
+    def one(i):
+        results[i], _ = router.predict_block(blocks[i])
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(len(blocks))]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()
+    for got, exp in zip(results, expected):
+        assert got is not None
+        assert np.array_equal(got, exp)
+    rounds = _obs.REGISTRY.counter("serve.batch.rounds").value() - rounds0
+    coalesced = (_obs.REGISTRY.counter("serve.batch.coalesced").value()
+                 - coal0)
+    # 8 concurrent requests under a 20ms linger cannot each have paid
+    # a private fan-out
+    assert rounds < len(blocks)
+    assert coalesced >= len(blocks) - rounds
+
+
+def test_score_rpc_replay_is_exactly_once(tmp_path):
+    """A retried/hedged score frame (same sender+seq) is answered from
+    the reply cache with the ORIGINAL partials — same bytes, same
+    version — even after a hot swap, exactly like a retried fetch."""
+    cfg = LinearConfig(minibatch=32, num_buckets=1 << 9, nnz_per_row=4)
+    base = str(tmp_path / "srv")
+    v1 = _manifest.write_snapshot_set(
+        base, {"w": np.arange(cfg.num_buckets, dtype=np.float32)},
+        world=1)
+    (server,) = _serve_group(base, 1)
+    try:
+        host, port = server.uri.rsplit(":", 1)
+        sock = _net.connect_with_retry((host, int(port)), 5.0)
+        f = sock.makefile("rwb")
+        hdr = {"op": "score", "kind": "linear", "rows": 2,
+               "sender": "replayer", "seq": 3}
+        arrays = {"i": np.asarray([1, 5, 2], np.int32),
+                  "v": np.asarray([2.0, 1.0, -1.0], np.float32)}
+        _net.send_frame(f, hdr, arrays)
+        r1, a1, _ = _net.recv_frame(f)
+        assert r1["version"] == v1
+        np.testing.assert_array_equal(
+            a1["p"], np.asarray([2.0, 5.0, -2.0], np.float32))
+        # swap to a model where every row is zero; the replayed seq
+        # must still answer with the v1 partials
+        v2 = _manifest.write_snapshot_set(
+            base, {"w": np.zeros(cfg.num_buckets, np.float32)}, world=1)
+        assert server.maybe_swap() and server.version == v2
+        dedup0 = _obs.REGISTRY.counter("serve.dedup_hits").value()
+        _net.send_frame(f, hdr, arrays)
+        r2, a2, _ = _net.recv_frame(f)
+        assert r2["version"] == v1
+        np.testing.assert_array_equal(a1["p"], a2["p"])
+        assert _obs.REGISTRY.counter("serve.dedup_hits").value() \
+            == dedup0 + 1
+        # a NEW seq scores against the new version
+        _net.send_frame(f, dict(hdr, seq=4), arrays)
+        r3, a3, _ = _net.recv_frame(f)
+        assert r3["version"] == v2
+        np.testing.assert_array_equal(a3["p"], np.zeros(3, np.float32))
+        sock.close()
+    finally:
         server.stop()
 
 

@@ -18,9 +18,14 @@ row come from; the final line is machine-readable:
 
     [serve-lab] {"qps": ..., "p50_ms": ..., "p99_ms": ..., ...}
 
+Both serving dataflows are drivable: --mode fetch pulls weight slices
+to the router (the PR-13 path), --mode score pushes shard-local
+scoring + router micro-batching (the fast path); auto (default)
+resolves to score when the scorer supports it.
+
 Usage: python tools/serve_lab.py [--shards N] [--buckets N] [--nnz N]
-       [--duration S] [--concurrency N] [--open-qps Q] [--swap]
-       [--chaos] [--json]
+       [--duration S] [--concurrency N] [--open-qps Q] [--mode M]
+       [--swap] [--chaos] [--json]
 """
 
 from __future__ import annotations
@@ -79,7 +84,7 @@ def run(num_shards: int = 2, num_buckets: int = 1 << 20,
         concurrency: int = 4, open_qps: float = 0.0,
         swap_every_s: float = 0.0, chaos_at_s: float = 0.0,
         deadline_ms: float = 0.0, seed: int = 0,
-        verbose: bool = True) -> dict:
+        serve_mode: str = "auto", verbose: bool = True) -> dict:
     """Drive one load run; returns the result row (the [serve-lab] dict).
 
     swap_every_s > 0: write a newer snapshot version every interval —
@@ -119,7 +124,8 @@ def run(num_shards: int = 2, num_buckets: int = 1 << 20,
             return list(state["uris"])
 
     router = Router(resolver(), LinearScorer(cfg), resolver=resolver,
-                    retry_deadline=max(30.0, duration_s * 2))
+                    retry_deadline=max(30.0, duration_s * 2),
+                    mode=serve_mode)
     blocks = _synth_blocks(rng, 8, minibatch, nnz)
     # warm the jit caches so compile time is not in the measured window
     router.predict_block(blocks[0])
@@ -248,15 +254,37 @@ def run(num_shards: int = 2, num_buckets: int = 1 << 20,
     stall_before = before["hists"].get("serve.swap_stall_s") or {}
     stall_ms = ((stall_h.get("sum", 0.0) - stall_before.get("sum", 0.0))
                 * 1e3)
-    # stage decomposition off the after-snapshot reservoirs (the single
-    # warmup request is ~1/reservoir of the samples — noise)
-    stage_table = _report.serve_stage_table(after)
+    # stage decomposition over THIS run's observations: count/sum are
+    # delta'd against the run-start snapshot so a previous run in the
+    # same process (bench.py runs fetch then score back to back)
+    # cannot leak stages it exercised — or its means — into this run's
+    # table. Quantiles still read the full reservoirs, which are
+    # recent-sample-biased toward this run (and the single warmup
+    # request is ~1/reservoir of the samples — noise).
+    run_hists = {}
+    for _name, _h in (after.get("hists") or {}).items():
+        _hb = (before.get("hists") or {}).get(_name) or {}
+        _dc = _h.get("count", 0) - _hb.get("count", 0)
+        if _dc > 0:
+            run_hists[_name] = {
+                **_h, "count": _dc,
+                "sum": _h.get("sum", 0.0) - _hb.get("sum", 0.0)}
+    stage_table = _report.serve_stage_table({**after,
+                                             "hists": run_hists})
     slos = _slo.evaluate(after, publish=False)
+
+    def hist_delta(name: str, field: str) -> float:
+        return ((after["hists"].get(name) or {}).get(field, 0.0)
+                - (before["hists"].get(name) or {}).get(field, 0.0))
+
+    batch_rounds = delta("serve.batch.rounds")
+    batch_n = hist_delta("serve.batch.size", "count")
     row = {
         "shards": num_shards,
         "buckets": num_buckets,
         "minibatch": minibatch,
         "mode": "open" if open_qps > 0 else "closed",
+        "serve_mode": router.mode,
         "concurrency": concurrency,
         "requests": done[0],
         "errors": errors[0],
@@ -281,6 +309,11 @@ def run(num_shards: int = 2, num_buckets: int = 1 << 20,
         "hedges_issued": delta("serve.hedge.issued"),
         "hedge_wins": delta("serve.hedge.wins"),
         "degraded_replies": degraded[0],
+        # micro-batcher plane (score mode; zeros under fetch)
+        "batch_rounds": batch_rounds,
+        "batch_coalesced": delta("serve.batch.coalesced"),
+        "batch_mean_size": (hist_delta("serve.batch.size", "sum")
+                            / batch_n if batch_n else 0.0),
     }
     for stage, st in (stage_table.get("stages") or {}).items():
         row[f"{stage}_ms"] = st["p50_ms"]
@@ -295,9 +328,10 @@ def run(num_shards: int = 2, num_buckets: int = 1 << 20,
                   f"p99={st['p99_ms']:8.3f} mean={st['mean_ms']:8.3f} "
                   f"n={st['count']}", flush=True)
         if stage_table.get("explained_frac") is not None:
-            print(f"  request p50 {stage_table['latency_p50_ms']:.3f} ms, "
+            print(f"  request mean {stage_table['latency_mean_ms']:.3f} "
+                  f"ms (p50 {stage_table['latency_p50_ms']:.3f} ms), "
                   f"{stage_table['explained_frac'] * 100:.0f}% explained "
-                  "by pack+fanout+sum+score", flush=True)
+                  "by batch_wait+pack+fanout+sum+score", flush=True)
     if verbose and slos:
         print("\n".join(_slo.format_lines(slos)), flush=True)
     router.close()
@@ -316,6 +350,7 @@ def overload_sweep(num_shards: int = 2, num_buckets: int = 1 << 20,
                    minibatch: int = 256, nnz: int = 32,
                    duration_s: float = 3.0, concurrency: int = 8,
                    deadline_ms: float = 0.0, seed: int = 0,
+                   serve_mode: str = "auto",
                    verbose: bool = True) -> dict:
     """The overload drill: measure capacity closed-loop, then step
     offered load to 3x capacity open-loop with the protection stack on
@@ -337,7 +372,8 @@ def overload_sweep(num_shards: int = 2, num_buckets: int = 1 << 20,
             print("[serve-lab] overload sweep: measuring capacity "
                   "(closed loop)...", flush=True)
         cap_row = run(num_shards, num_buckets, minibatch, nnz,
-                      duration_s, concurrency, seed=seed, verbose=False)
+                      duration_s, concurrency, seed=seed,
+                      serve_mode=serve_mode, verbose=False)
         capacity = cap_row["qps"]
         if verbose:
             print(f"[serve-lab] capacity {capacity:.0f} qps "
@@ -358,7 +394,8 @@ def overload_sweep(num_shards: int = 2, num_buckets: int = 1 << 20,
             # measure the converged regime, not the transient
             row = run(num_shards, num_buckets, minibatch, nnz,
                       max(duration_s, 6.0), conc, open_qps=offered,
-                      deadline_ms=deadline_ms, seed=seed, verbose=False)
+                      deadline_ms=deadline_ms, seed=seed,
+                      serve_mode=serve_mode, verbose=False)
             row["offered_qps"] = round(offered, 1)
             row["offered_x"] = mult
             steps.append(row)
@@ -381,6 +418,7 @@ def overload_sweep(num_shards: int = 2, num_buckets: int = 1 << 20,
     hedge_frac = last["hedges_issued"] / max(last["requests"], 1)
     return {
         "mode": "overload",
+        "serve_mode": cap_row["serve_mode"],
         "shards": num_shards, "buckets": num_buckets,
         "minibatch": minibatch, "deadline_ms": deadline_ms,
         "capacity_qps": capacity,
@@ -410,6 +448,12 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--open-qps", type=float, default=0.0,
                     help="open-loop target QPS (0 = closed loop)")
+    ap.add_argument("--mode", default="auto",
+                    choices=("auto", "fetch", "score"),
+                    help="serving dataflow: fetch (pull weight slices) "
+                         "or score (shard-local partials + micro-"
+                         "batching); auto picks score when the scorer "
+                         "supports it")
     ap.add_argument("--swap", action="store_true",
                     help="write a newer snapshot version every 0.5s "
                          "so the shards hot-swap under load")
@@ -459,7 +503,8 @@ def _main(args) -> int:
             num_shards=args.shards, num_buckets=args.buckets,
             minibatch=args.minibatch, nnz=args.nnz,
             duration_s=args.duration, concurrency=args.concurrency,
-            deadline_ms=args.deadline_ms, verbose=not args.json)
+            deadline_ms=args.deadline_ms, serve_mode=args.mode,
+            verbose=not args.json)
         print("[serve-lab] " + json.dumps(row, sort_keys=True),
               flush=True)
         return 0 if row["ok"] else 1
@@ -469,7 +514,7 @@ def _main(args) -> int:
               open_qps=args.open_qps,
               swap_every_s=0.5 if args.swap else 0.0,
               chaos_at_s=args.duration / 3 if args.chaos else 0.0,
-              deadline_ms=args.deadline_ms,
+              deadline_ms=args.deadline_ms, serve_mode=args.mode,
               verbose=not args.json)
     if not args.json:
         print(f"{row['mode']}-loop x{row['concurrency']}: "

@@ -403,6 +403,20 @@ declare_knob("WH_SERVE_RETRY_SEC", float, 30.0,
              "Router-side retry window for a dead serving shard: how long "
              "predict fan-outs re-resolve and redial before a batch fails.",
              group="serve")
+declare_knob("WH_SERVE_MODE", str, "auto",
+             "Serving dataflow: 'fetch' ships weight rows to the router, "
+             "'score' runs the shard-local fast path (partial margins "
+             "summed router-side), 'auto' picks score whenever the "
+             "scorer supports it.", group="serve")
+declare_knob("WH_SERVE_BATCH_MAX", int, 64,
+             "Micro-batcher round size cap: at most this many concurrent "
+             "predict requests coalesce into one score fan-out.",
+             group="serve")
+declare_knob("WH_SERVE_BATCH_WAIT_MS", float, 0.0,
+             "Micro-batcher linger: how long a round holds for more "
+             "arrivals before flushing (0 = flush immediately; batching "
+             "still emerges from arrivals during an executing round). "
+             "Ignored while degraded mode is active.", group="serve")
 declare_knob("WH_DEADLINE_MS", float, 0.0,
              "Per-request deadline the router binds around each predict "
              "batch, propagated to shards in frame headers; expired work "

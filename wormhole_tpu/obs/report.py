@@ -29,18 +29,31 @@ from wormhole_tpu.obs import slo as _slo
 REPORT_PREFIX = "[run-report] "
 REPORT_NAME = "run_report.json"
 
-#: serving pipeline stages, in request order; wire/queue decompose
-#: fanout (they overlap it, so the explained sum doesn't count them)
-SERVE_STAGES = ("pack", "fanout", "wire", "queue", "score", "sum")
-_PIPELINE_STAGES = ("pack", "fanout", "sum", "score")
+#: serving pipeline stages, in request order; wire/queue/partial
+#: decompose fanout (they overlap it, so the explained sum doesn't
+#: count them). batch_wait and partial only exist in score mode:
+#: batch_wait is the coalescer queue ahead of the round's fan-out,
+#: partial the slowest shard's own score-kernel time inside it.
+SERVE_STAGES = ("batch_wait", "pack", "fanout", "wire", "queue",
+                "partial", "score", "sum")
+_PIPELINE_STAGES = ("batch_wait", "pack", "fanout", "sum", "score")
 
 
 def serve_stage_table(aggregate: dict) -> dict:
     """Per-stage serving-latency attribution from the serve.stage.*
     histograms: {stages: {name: {p50_ms, p99_ms, mean_ms, count}},
-    latency_p50_ms, explained_p50_ms, explained_frac}. Empty when the
-    run never served. ``explained_frac`` is the acceptance metric: the
-    pipeline stages' p50 sum over the end-to-end request p50."""
+    latency_p50_ms, latency_mean_ms, explained_mean_ms,
+    explained_frac}. Empty when the run never served.
+
+    ``explained_frac`` is the acceptance metric: the pipeline stages'
+    MEAN sum over the end-to-end request mean. Means, not p50s —
+    request latency is the sum of its stages, and the mean of a sum
+    is the sum of the means regardless of how the stage durations
+    correlate, while a sum of p50s understates the latency p50
+    whenever a shared disturbance (a 256 MB snapshot write stealing
+    the core, GC, a noisy neighbor) inflates several stages of the
+    SAME request together. An attribution hole therefore shows up as
+    explained_frac < 1 instead of hiding inside correlation slack."""
     hists = aggregate.get("hists") or {}
     stages = {}
     for stage in SERVE_STAGES:
@@ -56,13 +69,17 @@ def serve_stage_table(aggregate: dict) -> dict:
     if not stages:
         return {}
     out = {"stages": stages}
-    p50 = _ms(metrics.hist_quantile(hists.get("serve.latency_s"), 0.50))
-    explained = sum(stages[s]["p50_ms"] or 0.0
+    lat = hists.get("serve.latency_s")
+    p50 = _ms(metrics.hist_quantile(lat, 0.50))
+    mean = _ms(lat["sum"] / lat["count"]) if lat and lat.get("count") \
+        else 0.0
+    explained = sum(stages[s]["mean_ms"] or 0.0
                     for s in _PIPELINE_STAGES if s in stages)
     out["latency_p50_ms"] = _round3(p50)
-    out["explained_p50_ms"] = _round3(explained)
-    out["explained_frac"] = (_round3(explained / p50)
-                             if p50 else None)
+    out["latency_mean_ms"] = _round3(mean)
+    out["explained_mean_ms"] = _round3(explained)
+    out["explained_frac"] = (_round3(explained / mean)
+                             if mean else None)
     return out
 
 
@@ -317,9 +334,10 @@ def format_lines(report: dict) -> list[str]:
                        for k, v in stages["stages"].items()))
         if stages.get("explained_frac") is not None:
             lines.append(
-                f"  serve latency p50={stages['latency_p50_ms']:.2f}ms, "
+                f"  serve latency mean={stages['latency_mean_ms']:.2f}ms "
+                f"(p50={stages['latency_p50_ms']:.2f}ms), "
                 f"{stages['explained_frac'] * 100:.0f}% explained by "
-                "pack+fanout+sum+score")
+                "batch_wait+pack+fanout+sum+score")
     tstages = report.get("train_stages")
     if tstages:
         lines.append(

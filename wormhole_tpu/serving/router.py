@@ -26,6 +26,7 @@ retried fetch can never straddle two versions. Busy bounces
 
 from __future__ import annotations
 
+import contextlib
 import heapq
 import socket as _socket
 import threading
@@ -35,6 +36,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+import wormhole_tpu.serving.fastpath as _fastpath
 from wormhole_tpu.config import knob_value
 from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
@@ -64,6 +66,16 @@ _STAGE_WIRE_S = _obs.REGISTRY.histogram("serve.stage.wire_s")
 _STAGE_QUEUE_S = _obs.REGISTRY.histogram("serve.stage.queue_s")
 _STAGE_SCORE_S = _obs.REGISTRY.histogram("serve.stage.score_s")
 _STAGE_SUM_S = _obs.REGISTRY.histogram("serve.stage.sum_s")
+# score-mode fast path: per-request coalescer queue wait, the slowest
+# shard's own kernel time per round (overlaps fanout, like wire/queue),
+# and the micro-batcher round accounting
+_STAGE_BATCH_WAIT_S = _obs.REGISTRY.histogram("serve.stage.batch_wait_s")
+_STAGE_PARTIAL_S = _obs.REGISTRY.histogram("serve.stage.partial_s")
+_BATCH_ROUNDS = _obs.REGISTRY.counter("serve.batch.rounds")
+_BATCH_COALESCED = _obs.REGISTRY.counter("serve.batch.coalesced")
+_BATCH_FLUSH_FULL = _obs.REGISTRY.counter("serve.batch.flush_full")
+_BATCH_FLUSH_TIMEOUT = _obs.REGISTRY.counter("serve.batch.flush_timeout")
+_BATCH_SIZE = _obs.REGISTRY.histogram("serve.batch.size")
 
 _EPOCH_REPLAYS = 8  # fan-out replays before a mixed-version batch fails
 
@@ -166,13 +178,111 @@ class _Slot:
         self.f = None
 
 
+class _BatchReq:
+    """One predict request parked in the micro-batcher: its ScorePack,
+    the caller's trace context and ambient deadline (batcher-thread
+    rounds rebind both), and the result slots the round fills."""
+
+    __slots__ = ("pack", "ctx", "dl", "t0", "t_enq", "done",
+                 "scores", "version", "meta", "error")
+
+    def __init__(self, pack, ctx, dl, t0):
+        self.pack = pack
+        self.ctx = ctx
+        self.dl = dl            # absolute time.monotonic deadline | None
+        self.t0 = t0            # pack start (end-to-end latency origin)
+        self.t_enq = time.perf_counter()
+        self.done = threading.Event()
+        self.scores = None
+        self.version = 0
+        self.meta: dict = {}
+        self.error: Optional[BaseException] = None
+
+
+class _Batcher:
+    """Dynamic micro-batcher: concurrent ``predict_block`` calls park
+    here and one dedicated thread drains them into coalesced score
+    rounds of at most WH_SERVE_BATCH_MAX members.
+
+    With the default WH_SERVE_BATCH_WAIT_MS=0 there is no artificial
+    linger — batching is *continuous*: while one round executes, new
+    arrivals queue, and the next round takes them all. Under a closed
+    loop the round size self-regulates to roughly the offered
+    concurrency; an idle router serves singles at zero added latency.
+    A positive linger holds a non-full round open for stragglers,
+    flushing early when any member's deadline would otherwise expire
+    mid-round — and is skipped entirely while degraded mode is active
+    (admission's job is shedding load then, not shaping bursts)."""
+
+    def __init__(self, router: "Router", max_batch: int, wait_s: float):
+        self._router = router
+        self._max = max(int(max_batch), 1)
+        self._wait = max(float(wait_s), 0.0)
+        self._cond = threading.Condition()
+        self._q: List[_BatchReq] = []  # wormlint: guarded-by(self._cond)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, req: _BatchReq):
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("router closed")
+            self._q.append(req)
+            self._cond.notify()
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return req.scores, req.version, req.meta
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+
+    def _linger(self) -> None:
+        """Hold a non-full round open up to the linger budget, clamped
+        by the earliest member deadline. Two clock domains on purpose:
+        the linger is perf_counter (like every stage time), deadlines
+        are absolute time.monotonic — never mix them."""
+        end = time.perf_counter() + self._wait
+        while not self._stop and len(self._q) < self._max:
+            wait = end - time.perf_counter()
+            dls = [r.dl for r in self._q if r.dl is not None]
+            if dls:
+                wait = min(wait, min(dls) - time.monotonic())
+            if wait <= 0:
+                _BATCH_FLUSH_TIMEOUT.inc()
+                return
+            self._cond.wait(wait)
+        if len(self._q) >= self._max:
+            _BATCH_FLUSH_FULL.inc()
+
+    def _loop(self) -> None:  # wormlint: thread-entry
+        while True:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait()
+                if not self._q and self._stop:
+                    return
+                if (self._wait > 0 and len(self._q) < self._max
+                        and not self._router._degrade.active()):
+                    self._linger()
+                batch = self._q[: self._max]
+                del self._q[: self._max]
+            if batch:
+                self._router._score_round(batch)
+
+
 class Router:
     """Thread-safe fan-out/merge client over a serving shard group."""
 
     def __init__(self, uris: List[str], scorer, sender: str = "router",
                  retry_deadline: Optional[float] = None,
                  resolver: Optional[Callable[[], Optional[List[str]]]] = None,
-                 connect_deadline: float = 10.0):
+                 connect_deadline: float = 10.0,
+                 mode: Optional[str] = None):
         self.scorer = scorer
         self.sender = sender
         self.resolver = resolver
@@ -209,6 +319,11 @@ class Router:
         # one hello up front: table row counts drive the key split, and
         # a shard configured for a different world would shard-range
         # differently than this router splits
+        # per-row-count shard boundary vectors for _split: the even
+        # shard_range split depends only on (rows, world), so the
+        # per-request python loop of searchsorted pairs collapses to
+        # one cached boundary array + one vectorized searchsorted
+        self._split_edges: Dict[int, np.ndarray] = {}
         hello = self._rpc(0, {"op": "hello"}, {})[0]
         if int(hello["world"]) != self.world:
             raise RuntimeError(
@@ -216,6 +331,26 @@ class Router:
                 f"was given {self.world} uris")
         self.full_rows = {k: int(v)
                           for k, v in hello["full_rows"].items()}
+        # serving dataflow (WH_SERVE_MODE): 'score' fans partial-margin
+        # work out to the shards through the micro-batcher; 'fetch' is
+        # the row-shipping fallback; 'auto' takes the fast path when
+        # the scorer implements a shard-local kernel
+        mode = (str(knob_value("WH_SERVE_MODE"))
+                if mode is None else str(mode))
+        if mode == "auto":
+            mode = ("score" if getattr(scorer, "score_kind", None)
+                    else "fetch")
+        if mode not in ("fetch", "score"):
+            raise ValueError(f"unknown WH_SERVE_MODE {mode!r}")
+        self.mode = mode
+        self._batcher: Optional[_Batcher] = None
+        if mode == "score":
+            key_table = scorer.tables[0]
+            self._score_edges = _fastpath.shard_edges(
+                self.full_rows[key_table], self.world)
+            self._batcher = _Batcher(
+                self, int(knob_value("WH_SERVE_BATCH_MAX")),
+                float(knob_value("WH_SERVE_BATCH_WAIT_MS")) / 1e3)
 
     @staticmethod
     def from_scheduler(client, scorer, world: int,
@@ -309,7 +444,8 @@ class Router:
         can never double-score. If the backup answers first it severs
         the pooled socket to unblock the primary's recv, and the
         primary's error path returns the backup's reply."""
-        hedge = self._hedge if hdr.get("op") == "fetch" else None
+        hedge = (self._hedge if hdr.get("op") in ("fetch", "score")
+                 else None)
         delay = hedge.delay_s() if hedge is not None else None
         if delay is None:
             return self._send_recv(slot.f, r, hdr, arrays, budget)
@@ -399,7 +535,7 @@ class Router:
                             raise RuntimeError(
                                 f"serve shard {r}: {reply['error']}")
                         if self._hedge is not None \
-                                and hdr.get("op") == "fetch":
+                                and hdr.get("op") in ("fetch", "score"):
                             self._hedge.observe(
                                 time.perf_counter() - t_req)
                         budget.succeeded()
@@ -419,13 +555,18 @@ class Router:
     # -- fan-out ------------------------------------------------------------
     def _split(self, keys: np.ndarray, rows: int) -> List[slice]:
         """Per-shard contiguous slices of a sorted key vector under the
-        even split (keys are sorted, so each shard's keys are one run)."""
-        out = []
-        for r in range(self.world):
-            lo, hi = shard_range(rows, r, self.world)
-            a, b = np.searchsorted(keys, [lo, hi])
-            out.append(slice(int(a), int(b)))
-        return out
+        even split (keys are sorted, so each shard's keys are one run).
+        The shard boundaries are a pure function of (rows, world) —
+        cached, so each request pays ONE vectorized searchsorted."""
+        edges = self._split_edges.get(rows)
+        if edges is None:
+            edges = np.asarray(
+                [shard_range(rows, r, self.world)[0]
+                 for r in range(self.world)] + [rows], np.int64)
+            self._split_edges[rows] = edges
+        cuts = np.searchsorted(keys, edges)
+        return [slice(int(cuts[r]), int(cuts[r + 1]))
+                for r in range(self.world)]
 
     def _rpc_traced(self, ctx, dl, r: int, header: dict,
                     arrays: Dict[str, np.ndarray]) -> tuple[dict, dict]:
@@ -437,8 +578,9 @@ class Router:
             if ctx is None:
                 return self._rpc(r, header, arrays)
             with _trace.bind(ctx):
-                with _trace.request_span("serve.rpc.fetch", cat="serve",
-                                         shard=r):
+                with _trace.request_span(
+                        f"serve.rpc.{header.get('op', 'fetch')}",
+                        cat="serve", shard=r):
                     return self._rpc(r, header, arrays)
 
     def _fanout(self, packed) -> tuple[list, list, int]:
@@ -516,6 +658,8 @@ class Router:
             t0 = time.perf_counter()
             try:
                 with _trace.request_span("serve.request", cat="serve"):
+                    if self._batcher is not None:
+                        return self._predict_score(blk)
                     return self._predict_block(blk)
             finally:
                 if gate is not None:
@@ -527,8 +671,13 @@ class Router:
         _STAGE_PACK_S.observe(time.perf_counter() - t0)
         meta = {"degraded": 0}
         try:
+            # fan-out is timed from the FIRST attempt: a hot swap
+            # landing mid-round costs a full replay plus backoff, and
+            # that burned budget must land in a stage or the
+            # explained_frac identity (sum of stage means == latency
+            # mean) breaks for every request in a swap window
+            tf0 = time.perf_counter()
             for attempt in range(_EPOCH_REPLAYS):
-                tf0 = time.perf_counter()
                 try:
                     with _trace.request_span("serve.stage.fanout",
                                              cat="serve"):
@@ -590,7 +739,188 @@ class Router:
             _FAILURES.inc()
             raise
 
+    # -- score fast path ----------------------------------------------------
+    def _predict_score(self, blk) -> tuple[np.ndarray, int, dict]:
+        """Score-mode entry: pack on the caller thread (cheap — live
+        COO entries only), park in the micro-batcher, and block until
+        the round that carried this request completes."""
+        t0 = time.perf_counter()
+        try:
+            pack = self.scorer.pack_score(blk)
+        except Exception:
+            _FAILURES.inc()  # round failures are counted by the round
+            raise
+        _STAGE_PACK_S.observe(time.perf_counter() - t0)
+        req = _BatchReq(pack, _trace.current_ctx(), _overload.current(),
+                        t0)
+        return self._batcher.submit(req)
+
+    def _score_fanout(self, pack) -> tuple[list, list, int]:
+        """One score round's fan-out: partition the round pack's
+        entries by owning shard, issue one ``score`` RPC per non-empty
+        shard, and check the replies came from ONE model version.
+        Returns (jobs, replies, version); jobs carry the permutation
+        needed to scatter the partial products back."""
+        order, counts = _fastpath.partition(pack.idx, self._score_edges)
+        if order is None:
+            si, sv, ss = pack.idx, pack.val, pack.seg
+        else:
+            si, sv, ss = pack.idx[order], pack.val[order], pack.seg[order]
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        hdr = {"op": "score", "kind": self.scorer.score_kind,
+               "rows": pack.rows, **self.scorer.score_header()}
+        difacto = self.scorer.score_kind == "difacto"
+        jobs = []  # (rank, payload arrays)
+        for r in range(self.world):
+            a, b = int(starts[r]), int(starts[r + 1])
+            if a == b:
+                continue
+            arrays = {"i": si[a:b], "v": sv[a:b]}
+            if difacto:
+                arrays["s"] = ss[a:b]
+            jobs.append((r, arrays))
+        if not jobs:
+            # a zero-nnz round still needs a version to stamp: shard 0
+            # scores an empty payload (all folds come back zero)
+            jobs = [(0, {"i": si[:0], "v": sv[:0]}
+                     if not difacto else
+                     {"i": si[:0], "v": sv[:0], "s": ss[:0]})]
+        ctx = _trace.current_ctx()
+        dl = _overload.current()
+        futs = [self._pool.submit(self._rpc_traced, ctx, dl, r,
+                                  dict(hdr), arrays)
+                for r, arrays in jobs]
+        got = [f.result() for f in futs]
+        versions = {int(reply["version"]) for reply, _ in got}
+        if len(versions) > 1:
+            raise _MixedVersions(versions, (jobs, order), got)
+        return (jobs, order), got, versions.pop()
+
+    def _score_assemble(self, pack, cuts, jobs_order, got):
+        """Scatter the per-shard partial products back into original
+        nonzero order, fold per row, and slice per micro-batch member.
+        The fold is the bitwise mirror of the trainer's segment_sum
+        (serving/fastpath.py docstring)."""
+        jobs, order = jobs_order
+        parts = [np.asarray(rarr["p"]) for _, rarr in got]
+        prod = _fastpath.restore_order(len(pack.idx), order, parts)
+        extras = {}
+        if self.scorer.score_kind == "difacto":
+            # cross-shard reassociation point of the documented ulp
+            # contract: per-shard [rows, k] partials summed rank-major
+            xv = np.asarray(got[0][1]["xv"]).copy()
+            x2 = np.asarray(got[0][1]["x2"]).copy()
+            for _, rarr in got[1:]:
+                xv += np.asarray(rarr["xv"])
+                x2 += np.asarray(rarr["x2"])
+            extras = {"xv": xv, "x2": x2}
+        scores = self.scorer.finalize(pack, prod, extras)
+        return [scores[cuts[m]: cuts[m + 1]]
+                for m in range(len(cuts) - 1)]
+
+    def _score_round(self, batch: List[_BatchReq]) -> None:
+        """Execute one coalesced fan-out on the batcher thread and
+        complete every member. Runs the same replay/degrade loop as
+        the fetch path: a hot swap landing mid-fan-out replays the
+        round; under sustained burn the mixed partials are served
+        stamped degraded (summing partials across versions is exactly
+        the bounded-staleness contract mixed fetched rows have)."""
+        now = time.perf_counter()
+        _BATCH_ROUNDS.inc()
+        _BATCH_SIZE.observe(len(batch))
+        if len(batch) > 1:
+            _BATCH_COALESCED.inc(len(batch) - 1)
+        for m in batch:
+            _STAGE_BATCH_WAIT_S.observe(now - m.t_enq)
+        dls = [m.dl for m in batch]
+        dl = None if any(d is None for d in dls) else max(dls)
+        ctx = next((m.ctx for m in batch if m.ctx is not None), None)
+        try:
+            with _overload.bind(dl), (
+                    _trace.bind(ctx) if ctx is not None
+                    else contextlib.nullcontext()):
+                self._score_round_bound(batch)
+        except BaseException as e:
+            for m in batch:
+                _FAILURES.inc()
+                m.error = e
+                m.done.set()
+
+    def _score_round_bound(self, batch) -> None:
+        # the fanout stage covers everything from round assembly to
+        # the last reply of the attempt that SUCCEEDED: concat,
+        # partition, the RPCs, and any mixed-version replays plus
+        # their backoff. All of it is real per-member wall time, and
+        # an unattributed stage is exactly what the explained_frac
+        # gate exists to catch
+        tf0 = time.perf_counter()
+        pack, cuts = _fastpath.concat_packs([m.pack for m in batch])
+        for attempt in range(_EPOCH_REPLAYS):
+            meta = {"degraded": 0}
+            try:
+                with _trace.request_span("serve.stage.fanout",
+                                         cat="serve"):
+                    jobs_order, got, version = self._score_fanout(pack)
+            except _MixedVersions as mv:
+                _EPOCH_RETRIES.inc()
+                self._degrade.observe_replay()
+                if self._degrade.active():
+                    jobs_order, got = mv.jobs, mv.got
+                    version = max(mv.versions)
+                    meta = {"degraded": 1,
+                            "versions": sorted(mv.versions)}
+                    self._degrade.served_degraded()
+                else:
+                    poll = float(knob_value("WH_SERVE_POLL_SEC"))
+                    time.sleep(min(0.01 * (2 ** attempt),
+                                   max(poll, 0.01)))
+                    continue
+            fanout = time.perf_counter() - tf0
+            slowest = max(
+                (float(r.get("served_s", 0.0))
+                 + float(r.get("queue_s", 0.0)) for r, _ in got),
+                default=0.0)
+            queued = max((float(r.get("queue_s", 0.0))
+                          for r, _ in got), default=0.0)
+            partial = max((float(r.get("served_s", 0.0))
+                           for r, _ in got), default=0.0)
+            # stage histograms are per-REQUEST distributions, like
+            # serve.latency_s: a round's stage time is observed once
+            # per member. Round-weighted means would understate the
+            # member-weighted time whenever big rounds are slow rounds
+            # (they are — queue buildup grows both together), breaking
+            # the explained_frac identity
+            wire = max(fanout - slowest, 0.0)
+            for _ in batch:
+                _STAGE_FANOUT_S.observe(fanout)
+                _STAGE_WIRE_S.observe(wire)
+                _STAGE_QUEUE_S.observe(queued)
+                _STAGE_PARTIAL_S.observe(partial)
+            tm0 = time.perf_counter()
+            with _trace.request_span("serve.stage.sum", cat="serve"):
+                per_member = self._score_assemble(pack, cuts,
+                                                  jobs_order, got)
+            dt_sum = time.perf_counter() - tm0
+            for _ in batch:
+                _STAGE_SUM_S.observe(dt_sum)
+            now = time.perf_counter()
+            for m, scores in zip(batch, per_member):
+                _ROUTER_REQUESTS.inc()
+                lat = now - m.t0
+                _LATENCY_S.observe(lat)
+                self._degrade.observe(lat)
+                m.scores = scores
+                m.version = version
+                m.meta = meta
+                m.done.set()
+            return
+        raise RuntimeError(
+            f"shard versions never agreed after {_EPOCH_REPLAYS} "
+            "fan-out replays")
+
     def close(self) -> None:
+        if self._batcher is not None:
+            self._batcher.close()
         self._hedge_timer.close()
         self._pool.shutdown(wait=False)
         with self._lock:

@@ -40,6 +40,7 @@ import numpy as np
 from wormhole_tpu.data.rowblock import RowBlock, to_device_batch
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.ops.spmv import row_squares, spmm, spmv
+import wormhole_tpu.serving.fastpath as _fastpath
 
 _MIN_CAP = 256
 
@@ -98,9 +99,28 @@ class LinearScorer:
 
     #: tables fetched from the shards, and the key space each indexes
     tables = ("w",)
+    #: shard-local scoring kernel (serving/fastpath.py); routers in
+    #: WH_SERVE_MODE=auto take the fast path when this is set
+    score_kind = "linear"
 
     def __init__(self, cfg):
         self.cfg = cfg
+
+    def pack_score(self, blk: RowBlock) -> _fastpath.ScorePack:
+        cfg = self.cfg
+        with _trace.request_span("serve.stage.pack", cat="serve",
+                                 rows=blk.size):
+            return _fastpath.pack_score(blk, cfg.minibatch,
+                                        cfg.row_capacity,
+                                        cfg.num_buckets)
+
+    def score_header(self) -> dict:
+        return {}
+
+    def finalize(self, pack: _fastpath.ScorePack, prod: np.ndarray,
+                 extras: Dict[str, np.ndarray]) -> np.ndarray:
+        return _fastpath.finalize_linear(
+            pack, prod, getattr(self.cfg, "prob_predict", False))
 
     def pack(self, blk: RowBlock) -> PackedBatch:
         cfg = self.cfg
@@ -139,6 +159,7 @@ class DifactoScorer:
     forward does, so a never-admitted bucket scores as unallocated."""
 
     tables = ("w", "cnt", "V")
+    score_kind = "difacto"
 
     def __init__(self, cfg):
         self.cfg = cfg
@@ -149,9 +170,16 @@ class DifactoScorer:
                                  rows=blk.size):
             db = to_device_batch(blk, cfg.minibatch, cfg.row_capacity,
                                  cfg.num_buckets)
-            vidx = (db.idx % np.int32(cfg.vb)).astype(np.int32)
             uniq_w, idxc = np.unique(db.idx, return_inverse=True)
-            uniq_v, vidxc = np.unique(vidx, return_inverse=True)
+            # the V key space is uniq_w folded mod vb: unique over the
+            # (already deduplicated) uniq_w is the same sorted key set
+            # and inverse as unique over the full per-nonzero vidx —
+            # one O(u log u) pass instead of a second O(nnz log nnz)
+            uv_small, inv_small = np.unique(
+                (uniq_w % np.int32(cfg.vb)).astype(np.int32),
+                return_inverse=True)
+            uniq_v = uv_small
+            vidxc = inv_small[idxc]
             uniq_w = uniq_w.astype(np.int64)
             uniq_v = uniq_v.astype(np.int64)
             return PackedBatch(
@@ -161,6 +189,26 @@ class DifactoScorer:
                 remap={"w": idxc.astype(np.int32),
                        "V": vidxc.astype(np.int32)},
                 dropped_rows=db.dropped_rows)
+
+    def pack_score(self, blk: RowBlock) -> _fastpath.ScorePack:
+        cfg = self.cfg
+        with _trace.request_span("serve.stage.pack", cat="serve",
+                                 rows=blk.size):
+            return _fastpath.pack_score(blk, cfg.minibatch,
+                                        cfg.row_capacity,
+                                        cfg.num_buckets)
+
+    def score_header(self) -> dict:
+        cfg = self.cfg
+        return {"threshold": int(cfg.threshold),
+                "l1_shrk": int(bool(cfg.l1_shrk)),
+                "vb": int(cfg.vb), "rep": ["V"]}
+
+    def finalize(self, pack: _fastpath.ScorePack, prod: np.ndarray,
+                 extras: Dict[str, np.ndarray]) -> np.ndarray:
+        return _fastpath.finalize_difacto(
+            pack, prod, extras["xv"], extras["x2"],
+            getattr(self.cfg, "prob_predict", False))
 
     def score(self, packed: PackedBatch,
               rows: Dict[str, np.ndarray]) -> np.ndarray:

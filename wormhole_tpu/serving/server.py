@@ -44,6 +44,7 @@ from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime import overload as _overload
 from wormhole_tpu.runtime.net import busy_reply, recv_frame, send_frame
+from wormhole_tpu.serving.fastpath import shard_score as _shard_score
 from wormhole_tpu.utils import manifest as _manifest
 
 _REQUESTS = _obs.REGISTRY.counter("serve.requests")
@@ -80,6 +81,44 @@ class ServingModel:
         self.clock = int(meta["clock"])
         self.rank = rank
         self.world = world
+        self._base = base
+        self._man = man
+        # full-table replicas for the score fast path (e.g. difacto's
+        # V: hashed mod vb, so a w-range partition scatters its rows
+        # across every shard) — loaded lazily on the first score that
+        # names the table, then eagerly on standby models off-path
+        self._replicated: Dict[str, np.ndarray] = {}
+        self._rep_lock = threading.Lock()
+
+    def replicated(self, table: str) -> np.ndarray:
+        """The FULL ``table`` at this model's version (not just this
+        shard's slice). Torn reads are retried only while the on-disk
+        manifest still names this version; once a newer set is
+        committed the raise is correct — the watcher's swap is already
+        in flight and the router replays against it."""
+        got = self._replicated.get(table)
+        if got is not None:
+            return got
+        with self._rep_lock:
+            got = self._replicated.get(table)
+            if got is not None:
+                return got
+            rng = {table: (0, self.full_rows[table])}
+            for _ in range(_TORN_RETRIES):
+                try:
+                    tables, _ = _manifest.load_slices(
+                        self._base, rng, self._man)
+                    break
+                except _manifest.TornSnapshot:
+                    man = _manifest.read_manifest(self._base)
+                    if int(man.get("version", -1)) != self.version:
+                        raise
+                    time.sleep(0.02)
+            else:
+                tables, _ = _manifest.load_slices(
+                    self._base, rng, self._man)
+            self._replicated[table] = tables[table]
+            return tables[table]
 
     def fetch(self, table: str, keys: np.ndarray) -> np.ndarray:
         """Rows at GLOBAL ids ``keys`` (must fall in this shard's
@@ -219,6 +258,10 @@ class ModelServer:
         # so caching the latest reply covers every retry pattern
         self._replies: Dict[str, tuple] = {}
         self._replies_lock = threading.Lock()
+        # tables score headers asked to replicate (e.g. difacto's V):
+        # remembered so a standby model loads its replicas OFF the
+        # request path, before the flip
+        self._replicate: set = set()
         self._gate = _overload.AdmissionController()
         self._shutdown = threading.Event()
         self._conns: set = set()
@@ -299,6 +342,8 @@ class ModelServer:
                 time.sleep(0.02)
         if standby is None:
             return False  # still torn; the next poll retries
+        for t in sorted(self._replicate):
+            standby.replicated(t)  # off-path: requests still see old
         t0 = time.perf_counter()
         with self._flip_lock:
             old = self._model.version
@@ -325,7 +370,7 @@ class ModelServer:
             with _trace.request_span(f"serve.shard.{op}", cat="serve",
                                      rank=self.rank):
                 resp = self._dispatch_op(op, header, arrays)
-            if op == "fetch" and "queue_s" not in resp[0] \
+            if op in ("fetch", "score") and "queue_s" not in resp[0] \
                     and "error" not in resp[0]:
                 # stage attribution for the router: how long the frame
                 # waited behind the gate/handler, and how long the fetch
@@ -357,22 +402,33 @@ class ModelServer:
                     "version": m.version, "full_rows": m.full_rows,
                     "tables": sorted(m.tables),
                     "last_seq": cached[0] if cached else -1}, {}
-        if op == "fetch":
+        if op in ("fetch", "score"):
             sender = header.get("sender", "?")
             seq = int(header.get("seq", -1))
+            # one reply cache for BOTH data-plane ops: hedges and
+            # socket-error retries resend the same (sender, seq), so a
+            # duplicate score is answered with the ORIGINAL partials —
+            # same bytes, same version — never recomputed
             if seq >= 0:
                 with self._replies_lock:
                     cached = self._replies.get(sender)
                 if cached is not None and cached[0] == seq:
                     _DEDUP_HITS.inc()
                     return cached[1], cached[2]
-            out: Dict[str, np.ndarray] = {}
-            nrows = 0
-            for t in header.get("tables", []):
-                rows = m.fetch(t, arrays[f"k:{t}"])
-                out[f"r:{t}"] = rows
-                nrows += len(rows)
-            _ROWS.inc(nrows)
+            if op == "score":
+                for t in header.get("rep", ()):
+                    self._replicate.add(t)
+                    m.replicated(t)
+                out = _shard_score(header, arrays, m)
+                _ROWS.inc(len(arrays.get("i", ())))
+            else:
+                out = {}
+                nrows = 0
+                for t in header.get("tables", []):
+                    rows = m.fetch(t, arrays[f"k:{t}"])
+                    out[f"r:{t}"] = rows
+                    nrows += len(rows)
+                _ROWS.inc(nrows)
             resp = ({"ok": 1, "version": m.version, "seq": seq}, out)
             if seq >= 0:
                 with self._replies_lock:
