@@ -373,11 +373,14 @@ print_sec = 3600
         # Two runs: the production operating point (async overlapped
         # sync + key caching) and the plain synchronous plane, so the
         # row shows the overlap/caching gain, not just one number.
-        def run_dist(tag, async_sync, plane="tcp", extra_argv=()):
+        def run_dist(tag, async_sync, plane="tcp", extra_argv=(),
+                     wire_env=None):
             obs_dir = f"{td}/obs_dist_{tag}"
             flag = "1" if async_sync else "0"
             ev = {"WH_OBS_DIR": obs_dir, "WH_ASYNC_SYNC": flag,
                   "WH_KEYCACHE": flag, "WH_PS_PLANE": plane}
+            if wire_env:
+                ev.update(wire_env)
             if plane == "hot":
                 # the worker needs a real >= 2 device mesh; must land
                 # before its jax import, hence via the environment
@@ -407,6 +410,14 @@ print_sec = 3600
 
         wire, dist_eps, obs_dir = run_dist("async", True)
         wire_off, dist_eps_off, _ = run_dist("sync", False)
+        # the wire codec at its full operating point on the same data:
+        # int8 error-feedback deltas both directions + byte-shuffle
+        # framing (WH_WIRE family, runtime/net.py). Same async+keycache
+        # plane as the recorded dist row, so the delta IS the codec.
+        wire_q, dist_eps_q, _ = run_dist(
+            "int8ef", True,
+            wire_env={"WH_WIRE": "int8", "WH_WIRE_EF": "1",
+                      "WH_WIRE_COMP": "bshuf"})
         # the hot plane at the same operating point: tables sharded over
         # the forced 4-device host mesh, TCP tier at flush barriers only
         wire_hot, hot_eps, obs_dir_hot = run_dist(
@@ -433,7 +444,7 @@ print_sec = 3600
     # dense wire at this operating point: push z+n deltas, pull w+z+n
     dense_bytes = 5 * num_buckets * 4
     return dist_eps, dist_eps_off, single_eps, wire, wire_off, \
-        dense_bytes, obs, hot_eps, wire_hot, obs_hot
+        dense_bytes, obs, hot_eps, wire_hot, obs_hot, wire_q, dist_eps_q
 
 
 # ---------------------------------------------------------------- kmeans
@@ -768,7 +779,8 @@ def main():
     got = _safe("linear_ps", bench_linear_ps)
     if got is not None:
         (dist_eps, dist_eps_off, single_eps, wire, wire_off,
-         dense_bytes, obs, hot_eps, wire_hot, obs_hot) = got
+         dense_bytes, obs, hot_eps, wire_hot, obs_hot,
+         wire_q, dist_eps_q) = got
         # vs_baseline here = ratio to the single-process run on the same
         # data/platform; the recorded run is the production operating
         # point (WH_ASYNC_SYNC=1 WH_KEYCACHE=1), async_off_eps the plain
@@ -779,7 +791,26 @@ def main():
              ps_sync_overlap_frac=wire.get("sync_overlap_frac"),
              ps_push_ms_per_sync=wire.get("push_ms_per_sync"),
              ps_pull_ms_per_sync=wire.get("pull_ms_per_sync"),
-             keycache_hit_rate=wire.get("keycache_hit_rate"))
+             keycache_hit_rate=wire.get("keycache_hit_rate"),
+             wire_codec=wire.get("wire_codec"),
+             wire_bytes_per_sync=wire.get("bytes_per_sync"),
+             wire_bytes_per_sync_int8ef=wire_q.get("bytes_per_sync"))
+        # the codec row: same operating point (async + keycache), int8
+        # error-feedback push deltas + bf16-capped pull refreshes +
+        # bshuf framing.
+        # vs_baseline = speedup over the raw-f32 dist row — the codec
+        # must not cost throughput while it cuts the wire
+        emit("linear_ftrl_ps_dist_64m_buckets_int8ef", dist_eps_q,
+             "examples/sec", dist_eps_q / dist_eps,
+             wire_codec=wire_q.get("wire_codec"),
+             wire_ef=wire_q.get("wire_ef"),
+             wire_comp=wire_q.get("wire_comp"),
+             wire_bytes_per_sync=wire_q.get("bytes_per_sync"),
+             raw_bytes_per_sync=wire.get("bytes_per_sync"),
+             wire_savings_x=round(wire["bytes_per_sync"]
+                                  / max(wire_q.get("bytes_per_sync", 0),
+                                        1), 2),
+             ef_resid_norm=wire_q.get("wire_ef_resid_norm"))
         # vs_baseline = fraction of what a dense-table sync would move;
         # the saving field compares the LAST train round (epoch 2, where
         # the key cache ships digest-only frames) against the cache-off

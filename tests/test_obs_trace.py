@@ -255,9 +255,13 @@ def test_prometheus_exposition_golden():
     snap = {
         "counters": {"net.bytes_sent": 17, "serve.router.requests": 3,
                      "admit.sheds": 5, "flight.dumps": 2,
-                     "serve.batch.rounds": 9},
+                     "serve.batch.rounds": 9,
+                     "net.bshuf.bytes_out": 7,
+                     "wire.codec.bytes_raw": 400,
+                     "wire.codec.bytes_wire": 100},
         "gauges": {"slo.serve.latency_burn": 0.25,
-                   "prof.overhead_frac": 0.004},
+                   "prof.overhead_frac": 0.004,
+                   "wire.codec.ef_resid_norm": 0.125},
         "hists": {
             "serve.batch.size": {"count": 3, "sum": 12.0, "min": 1.0,
                                  "max": 8.0, "res": [1.0, 3.0, 8.0]},
@@ -279,16 +283,24 @@ def test_prometheus_exposition_golden():
         "wh_admit_sheds_total 5\n"
         "# TYPE wh_flight_dumps_total counter\n"
         "wh_flight_dumps_total 2\n"
+        "# TYPE wh_net_bshuf_bytes_out_total counter\n"
+        "wh_net_bshuf_bytes_out_total 7\n"
         "# TYPE wh_net_bytes_sent_total counter\n"
         "wh_net_bytes_sent_total 17\n"
         "# TYPE wh_serve_batch_rounds_total counter\n"
         "wh_serve_batch_rounds_total 9\n"
         "# TYPE wh_serve_router_requests_total counter\n"
         "wh_serve_router_requests_total 3\n"
+        "# TYPE wh_wire_codec_bytes_raw_total counter\n"
+        "wh_wire_codec_bytes_raw_total 400\n"
+        "# TYPE wh_wire_codec_bytes_wire_total counter\n"
+        "wh_wire_codec_bytes_wire_total 100\n"
         "# TYPE wh_prof_overhead_frac gauge\n"
         "wh_prof_overhead_frac 0.004\n"
         "# TYPE wh_slo_serve_latency_burn gauge\n"
         "wh_slo_serve_latency_burn 0.25\n"
+        "# TYPE wh_wire_codec_ef_resid_norm gauge\n"
+        "wh_wire_codec_ef_resid_norm 0.125\n"
         "# TYPE wh_serve_batch_size summary\n"
         'wh_serve_batch_size{quantile="0.5"} '
         + _q("serve.batch.size", 0.5) + "\n"

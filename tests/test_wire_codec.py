@@ -1,0 +1,686 @@
+"""Wire codec v2: quantized-row encodings, error-feedback algebra,
+byte-shuffle framing, hello negotiation/fallback, and the per-plane
+contracts — PS push/pull parity, BSP recovered-run bit-identity, and
+the serving WH_SERVE_WIRE ulp contract (docs/distributed.md "The wire
+codec", docs/serving.md "Reply wire format")."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.runtime.net import (
+    EFQuant, QuantRows, WIRE_ENCODINGS, _bf16_round, _decode, _encode,
+    quantize_rows,
+)
+from wormhole_tpu.runtime.ps_server import (
+    PSClient, ServerNode, SyncedStore,
+)
+
+
+# ------------------------------------------------------------- encodings
+def _roundtrip(qr):
+    meta, buf = _encode(qr)
+    return _decode(meta, buf)
+
+
+def _bf16f(a):
+    """f32 values after bf16 RNE truncation (_bf16_round returns the
+    raw uint16 bit pattern)."""
+    u = _bf16_round(np.ascontiguousarray(a, np.float32))
+    return (u.astype(np.uint32) << 16).view(np.float32).reshape(a.shape)
+
+
+@pytest.mark.parametrize("enc", [e for e in WIRE_ENCODINGS if e != "raw"])
+@pytest.mark.parametrize("shape", [(256,), (32, 8)])
+def test_quantize_roundtrip_error_bounds(enc, shape):
+    a = np.random.default_rng(0).normal(size=shape).astype(np.float32)
+    qr = quantize_rows(a, enc)
+    got = _roundtrip(qr)
+    assert got.shape == a.shape and got.dtype == np.float32
+    scale = float(np.max(np.abs(a)))
+    tol = {"bf16": scale / 128,
+           "int8": scale / 127, "int4": scale / 7}[enc]
+    np.testing.assert_allclose(got, a, atol=tol)
+    np.testing.assert_array_equal(got, qr.dequant())  # sender == receiver
+
+
+def test_wire_byte_ratios():
+    a = np.zeros((64, 16), np.float32) + 0.5
+    raw = a.nbytes
+    assert quantize_rows(a, "bf16").wire_nbytes() == raw // 2
+    # int8: 1 byte/elem + one f32 scale per row
+    assert quantize_rows(a, "int8").wire_nbytes() == raw // 4 + 64 * 4
+    # int4: two elems per byte + one f32 scale per row
+    assert quantize_rows(a, "int4").wire_nbytes() == raw // 8 + 64 * 4
+
+
+def test_int4_packs_odd_lengths():
+    a = np.random.default_rng(1).normal(size=7).astype(np.float32)
+    got = _roundtrip(quantize_rows(a, "int4"))
+    np.testing.assert_allclose(got, a, atol=float(np.abs(a).max()) / 7)
+
+
+def test_per_row_scales_beat_global_scale():
+    """The v1 bug this codec fixes: ONE hot row used to flatten every
+    other row's resolution under a global absmax scale."""
+    a = np.random.default_rng(2).normal(size=(64, 8)).astype(np.float32)
+    a[0] *= 1e4  # hot row
+    per_row = _roundtrip(quantize_rows(a, "int8"))
+    legacy = _decode(*_encode(a, fixed_bytes=1))  # scalar absmax
+    err_pr = np.abs(per_row[1:] - a[1:]).max()
+    err_gl = np.abs(legacy[1:] - a[1:]).max()
+    assert err_pr < err_gl / 100
+    # the hot row itself keeps int8 relative resolution
+    np.testing.assert_allclose(per_row[0], a[0],
+                               atol=float(np.abs(a[0]).max()) / 127)
+
+
+def test_quantrows_slice_matches_whole():
+    """Per-server shard slices of ONE QuantRows must decode exactly as
+    the corresponding slice of the whole — the push splitter depends
+    on it."""
+    a = np.random.default_rng(3).normal(size=(40, 4)).astype(np.float32)
+    qr = quantize_rows(a, "int8")
+    whole = qr.dequant()
+    part = qr[10:30]
+    assert isinstance(part, QuantRows)
+    np.testing.assert_array_equal(part.dequant(), whole[10:30])
+    np.testing.assert_array_equal(_roundtrip(part), whole[10:30])
+
+
+def test_bf16_rounding_idempotent_and_matches_legacy():
+    """bf16 RNE is idempotent — the property the BSP allgather leg and
+    the serving retry path both lean on for bit-identity."""
+    a = np.random.default_rng(4).normal(size=512).astype(np.float32)
+    once = _roundtrip(quantize_rows(a, "bf16"))
+    twice = _roundtrip(quantize_rows(once, "bf16"))
+    np.testing.assert_array_equal(once, twice)
+    np.testing.assert_array_equal(once, _bf16f(a))
+    np.testing.assert_array_equal(once, _decode(*_encode(a, 2)))
+
+
+def test_bshuf_framing_roundtrip_and_wins_on_smooth_data():
+    rng = np.random.default_rng(5)
+    smooth = np.cumsum(rng.normal(size=1 << 14).astype(np.float32) * 1e-3)
+    m_b, b_b = _encode(smooth, compress="bshuf")
+    m_z, b_z = _encode(smooth, compress="zlib")
+    np.testing.assert_array_equal(_decode(m_b, b_b), smooth)
+    assert m_b["comp"] == "bshuf+zlib"
+    assert m_b["nbytes"] < m_z["nbytes"] < smooth.nbytes
+    # incompressible data: compression is dropped, not shipped larger
+    noise = rng.integers(0, 2**32, size=1 << 12, dtype=np.uint32).view(
+        np.float32)
+    m_n, b_n = _encode(noise, compress="bshuf")
+    assert "comp" not in m_n and m_n["nbytes"] == noise.nbytes
+
+
+def test_delta_index_encoding_roundtrip_and_shrinks_sorted_keys():
+    """Under the negotiated bshuf mode, sorted 1-D index arrays ship
+    delta-encoded (first value + gaps): their high byte planes go to
+    zero, so bshuf+zlib collapses what absolute sorted keys leave as
+    incompressible low-byte noise. Lossless, and never applied outside
+    bshuf mode or to unsorted arrays."""
+    rng = np.random.default_rng(11)
+    keys = np.unique(rng.integers(0, 1 << 26, size=1 << 16)).astype(np.int64)
+    m_d, b_d = _encode(keys, compress="bshuf")
+    assert m_d.get("dlt") == 1
+    np.testing.assert_array_equal(_decode(m_d, b_d), keys)
+    m_a, b_a = _encode(keys, compress="zlib")  # absolute form
+    assert "dlt" not in m_a
+    assert m_d["nbytes"] < 0.65 * m_a["nbytes"], (m_d["nbytes"],
+                                                  m_a["nbytes"])
+    # unsorted stays absolute; raw framing stays absolute
+    shuf = keys.copy()
+    rng.shuffle(shuf)
+    m_s, b_s = _encode(shuf, compress="bshuf")
+    assert "dlt" not in m_s
+    np.testing.assert_array_equal(_decode(m_s, b_s), shuf)
+    m_r, b_r = _encode(keys)
+    assert "dlt" not in m_r
+    np.testing.assert_array_equal(_decode(m_r, b_r), keys)
+    # i32 path
+    k32 = keys[: 1 << 12].astype(np.int32)
+    m_3, b_3 = _encode(k32, compress="bshuf")
+    assert m_3.get("dlt") == 1 and m_3["enc"] == "i32"
+    np.testing.assert_array_equal(_decode(m_3, b_3), k32)
+
+
+# --------------------------------------------------------- error feedback
+def test_ef_accumulated_error_bounded():
+    """Transmit Q(delta + r), keep r <- (delta + r) - Q(.): the summed
+    dequantized stream tracks the exact f32 sum to within ~one
+    quantization step, while stateless quantization random-walks."""
+    rng = np.random.default_rng(6)
+    space = 4096
+    for enc in ("int8", "int4"):
+        efq = EFQuant(enc)
+        exact = np.zeros(space, np.float32)
+        with_ef = np.zeros(space, np.float32)
+        without = np.zeros(space, np.float32)
+        for _ in range(24):
+            idx = np.unique(rng.integers(0, space, size=space // 2))
+            d = rng.normal(size=idx.size).astype(np.float32) * 0.01
+            exact[idx] += d
+            with_ef[idx] += efq.apply(idx, d).dequant()
+            without[idx] += quantize_rows(d, enc).dequant()
+        err_ef = np.linalg.norm(with_ef - exact)
+        err_no = np.linalg.norm(without - exact)
+        assert err_ef < err_no / 1.5, (enc, err_ef, err_no)
+        assert efq.resid_norm() > 0.0
+
+
+def test_ef_residual_advances_once_replay_reuses_bytes():
+    """Exactly-once under the codec: the residual moves at quantize
+    time, ONCE; any replay (journal, need_keys, retry) re-serializes
+    the same QuantRows to identical bytes."""
+    efq = EFQuant("int8")
+    idx = np.arange(16)
+    d = np.linspace(-1, 1, 16, dtype=np.float32)
+    qr = efq.apply(idx, d)
+    r1 = efq.resid_norm()
+    m1, b1 = _encode(qr)
+    m2, b2 = _encode(qr)  # "replay"
+    assert b1 == b2 and m1 == m2
+    assert efq.resid_norm() == r1  # untouched by serialization
+    # next round folds the stored residual back in
+    qr2 = efq.apply(idx, np.zeros(16, np.float32))
+    total = qr.dequant() + qr2.dequant()
+    np.testing.assert_allclose(total, d, atol=2.0 / 127)
+
+
+def test_ef_reset_clears_residuals():
+    efq = EFQuant("int4")
+    efq.apply(np.arange(8),
+              np.linspace(0.1, 0.9, 8).astype(np.float32))
+    assert efq.resid_norm() > 0
+    efq.reset()
+    assert efq.resid_norm() == 0.0
+
+
+# ------------------------------------------------------ PS plane end-to-end
+@pytest.fixture
+def group():
+    nodes = [ServerNode(r, 2) for r in range(2)]
+    for n in nodes:
+        n.serve()
+    yield nodes
+    for n in nodes:
+        n.stop()
+
+
+class _Store:
+    def __init__(self, tables):
+        self.tables = {k: np.array(v, np.float32)
+                       for k, v in tables.items()}
+
+    def to_numpy(self):
+        return {k: v.copy() for k, v in self.tables.items()}
+
+    def from_numpy(self, arrays):
+        for k, v in arrays.items():
+            self.tables[k] = np.array(v, np.float32)
+
+
+def _train(store, syncs, rng, scale=0.01):
+    """Apply `syncs` rounds of random sparse updates through sync()."""
+    n = store.store.tables["w"].size
+    for _ in range(syncs):
+        idx = rng.integers(0, n, size=n // 4)
+        store.store.tables["w"][idx] += (
+            rng.normal(size=idx.size).astype(np.float32) * scale)
+        store.sync()
+
+
+def _fresh_group():
+    nodes = [ServerNode(r, 2) for r in range(2)]
+    for n in nodes:
+        n.serve()
+    return nodes
+
+
+@pytest.mark.parametrize("enc", ["bf16", "int8", "int4"])
+def test_ps_push_pull_parity_quantized(monkeypatch, enc):
+    """An int8/int4+EF worker converges to ~the raw worker's server
+    state: quantization error stays bounded across many syncs instead
+    of accumulating."""
+    rng_a = np.random.default_rng(7)
+    rng_b = np.random.default_rng(7)
+
+    nodes = _fresh_group()
+    try:
+        raw_client = PSClient([n.uri for n in nodes], sender="raw")
+        st_raw = SyncedStore(_Store({"w": np.zeros(512)}), raw_client,
+                             max_delay=1)
+        st_raw.init()
+        _train(st_raw, 12, rng_a)
+        want = raw_client.pull()["w"]
+        raw_client.close()
+    finally:
+        for n in nodes:
+            n.stop()
+
+    monkeypatch.setenv("WH_WIRE", enc)
+    monkeypatch.setenv("WH_WIRE_EF", "1")
+    monkeypatch.setenv("WH_WIRE_COMP", "bshuf")
+    nodes = _fresh_group()  # fresh server state for the quantized run
+    try:
+        q_client = PSClient([n.uri for n in nodes], sender="qw")
+        assert q_client.wire_enc == enc
+        st_q = SyncedStore(_Store({"w": np.zeros(512)}), q_client,
+                           max_delay=1)
+        st_q.init()
+        _train(st_q, 12, rng_b)
+        got = q_client.pull()["w"]
+
+        denom = max(float(np.linalg.norm(want)), 1e-30)
+        rel = float(np.linalg.norm(got - want)) / denom
+        assert rel < {"bf16": 2e-2, "int8": 2e-2, "int4": 0.12}[enc], rel
+        ws = st_q.wire_stats()
+        assert ws["wire_codec"] == enc and bool(ws["wire_ef"])
+        assert 0 < ws["wire_bytes_wire"] < ws["wire_bytes_raw"]
+        q_client.close()
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_ps_push_wire_cap_floors_accumulator_tables(group, monkeypatch):
+    """A store that declares wire-capped tables (TableSpec.wire_cap —
+    FTRL's n, difacto's n/cnt/nV) ships those tables' push deltas at
+    bf16 even under WH_WIRE=int8: absmax group codes quantize a cold
+    bucket's accumulator delta at its hot neighbor's granularity,
+    mis-scaling per-coordinate learning rates in a way EF can't undo."""
+    nodes = group
+    monkeypatch.setenv("WH_WIRE", "int8")
+    monkeypatch.setenv("WH_WIRE_EF", "1")
+
+    class _CapStore(_Store):
+        def wire_cap_names(self):
+            return {"n"}
+
+    client = PSClient([n.uri for n in nodes], sender="capw")
+    st = SyncedStore(_CapStore({"z": np.zeros(256), "n": np.zeros(256)}),
+                     client, max_delay=1)
+    st.init()
+    # a hot-neighbor accumulator delta: one huge value per 64-group
+    d = np.full(256, 2.0, np.float32)
+    d[::64] = 1e4
+    st.store.tables["n"] += d
+    st.store.tables["z"] += 0.5
+    st.sync()
+    assert st._efq["n"].enc == "bf16" and st._efq["z"].enc == "int8"
+    got = client.pull()
+    # bf16 keeps the cold buckets' deltas to ~0.4% relative error;
+    # int8 absmax grouping would have quantized them at ~1e4/254 = 39
+    np.testing.assert_allclose(got["n"], d, rtol=1e-2)
+    np.testing.assert_allclose(got["z"], np.full(256, 0.5), atol=0.01)
+    client.close()
+
+
+def test_ps_pull_derived_skip_recomputes_w(group, monkeypatch):
+    """Quantized pulls omit derived tables from the reply (FTRL's
+    w = prox(z, n) is a pure function of its shipped sources) and the
+    client reconstructs identical rows via the shared ftrl_prox_rows —
+    one fewer bf16 table per pull. The server honors `skip` ONLY for
+    derived tables, so a bad request can never drop additive state."""
+    nodes = group
+    monkeypatch.setenv("WH_WIRE", "int8")
+    monkeypatch.setenv("WH_WIRE_EF", "1")
+    spec = {"kind": "ftrl_prox", "lr_eta": 0.1, "lr_beta": 1.0,
+            "lambda_l1": 0.05, "lambda_l2": 0.0}
+    client = PSClient([n.uri for n in nodes], sender="drv")
+    st = SyncedStore(_Store({"w": np.zeros(256), "z": np.zeros(256),
+                             "n": np.zeros(256)}),
+                     client, max_delay=1, derived={"w": spec})
+    st.init()
+    assert st._pull_skip() == ["w"]
+    rng = np.random.default_rng(3)
+    for _ in range(4):
+        st.store.tables["z"] += (
+            rng.normal(size=256).astype(np.float32) * 0.3)
+        st.store.tables["n"] += rng.random(256).astype(np.float32)
+        st.sync()
+    # the wire really omits w on a skip pull, and refuses to omit an
+    # additive table
+    _, _, tables = client.pull_sparse([0, 0], skip=["w"])
+    assert "w" not in tables and "z" in tables and "n" in tables
+    _, _, t2 = client.pull_sparse([0, 0], skip=["z"])
+    assert "z" in t2
+    # the locally reconstructed w matches the server's authoritative
+    # prox (inputs crossed the wire at bf16: ~0.4% relative)
+    want = client.pull()["w"]
+    np.testing.assert_allclose(st.store.tables["w"], want, atol=2e-3)
+    assert float(np.max(np.abs(want))) > 0  # the comparison is real
+    client.close()
+
+
+def test_ps_pull_derived_skip_quiet_shard_consistency(group, monkeypatch):
+    """A quiet shard (since >= clock, the empty fast-path reply) and a
+    dirty shard must agree on the skip: the quiet shard shipping an
+    empty `w` part while the dirty one omits its rows leaves the
+    client's merged `w` shorter than its merged index — the exact
+    shape-mismatch crash chaos_lab --codec hit on the rollback re-pull
+    after kill@pull. The fast path must omit skipped tables too, and
+    the client must discard a PARTIAL derived part (mixed world where
+    only some servers honor the skip) and recompute from z/n."""
+    nodes = group
+    monkeypatch.setenv("WH_WIRE", "int8")
+    monkeypatch.setenv("WH_WIRE_EF", "1")
+    spec = {"kind": "ftrl_prox", "lr_eta": 0.1, "lr_beta": 1.0,
+            "lambda_l1": 0.05, "lambda_l2": 0.0}
+    client = PSClient([n.uri for n in nodes], sender="qsh")
+    st = SyncedStore(_Store({"w": np.zeros(256), "z": np.zeros(256),
+                             "n": np.zeros(256)}),
+                     client, max_delay=1, derived={"w": spec})
+    st.init()
+    rng = np.random.default_rng(11)
+    for _ in range(2):
+        st.store.tables["z"] += (
+            rng.normal(size=256).astype(np.float32) * 0.3)
+        st.store.tables["n"] += rng.random(256).astype(np.float32)
+        st.sync()
+    # shard 0 replays everything (since=0, the rollback-re-pull shape);
+    # shard 1 takes the quiet fast path (since far past its clock)
+    _, groups, tables = client.pull_sparse([0, 10**6], skip=["w"])
+    gidx = groups[client.full_rows["z"]]
+    assert gidx.size > 0
+    assert "w" not in tables, "quiet-shard fast path ignored the skip"
+    assert tables["z"].shape[0] == gidx.size
+    filled = st._fill_derived(groups, dict(tables))
+    assert filled["w"].shape[0] == gidx.size
+    # a stray partial part (old server in a mixed world) is discarded,
+    # not adopted
+    part = dict(tables)
+    part["w"] = np.zeros(0, np.float32)
+    filled = st._fill_derived(groups, part)
+    assert filled["w"].shape[0] == gidx.size
+    np.testing.assert_allclose(filled["w"], st.store.tables["w"][gidx],
+                               atol=2e-3)
+    client.close()
+
+
+def test_ps_negotiation_fallback_old_peer(group, monkeypatch):
+    """A server that never acks `wire` must still converge: the client
+    degrades to the legacy bf16 truncation form (never the scalar
+    absmax int8 form — see _wire_fb) instead of sending frames the
+    peer can't decode."""
+    nodes = group
+    monkeypatch.setenv("WH_WIRE", "int8")
+    monkeypatch.setenv("WH_WIRE_COMP", "bshuf")
+    # simulate an old peer: strip `wire`/`wire_comp` from every hello
+    # ack before the client latches it (connections dial lazily, so
+    # patching the latch catches them all)
+    orig_latch = PSClient._latch_hello
+
+    def latch_old(self, r, h):
+        h = dict(h)
+        h.pop("wire", None)
+        h.pop("wire_comp", None)
+        orig_latch(self, r, h)
+
+    monkeypatch.setattr(PSClient, "_latch_hello", latch_old)
+    client = PSClient([n.uri for n in nodes], sender="old")
+    st = SyncedStore(_Store({"w": np.zeros(64)}), client, max_delay=1)
+    assert st._wire_fb() == 2  # legacy bf16 truncation, NOT scalar int8
+    st.init()
+    st.store.tables["w"] += 0.5
+    st.sync()
+    got = client.pull()["w"]
+    np.testing.assert_allclose(got, np.full(64, 0.5), atol=0.5 / 128)
+    # nothing was accounted as codec traffic
+    assert st.wire_stats()["wire_bytes_wire"] == 0
+    client.close()
+
+
+def test_ps_pull_replies_quantized_and_lost_reply_self_corrects(
+        group, monkeypatch):
+    """Pulls are absolute-value refreshes: a second pull of the same
+    rows lands within quantization error of the server's truth even
+    though the first reply's quantization error went to the EF
+    residual."""
+    nodes = group
+    monkeypatch.setenv("WH_WIRE", "int8")
+    writer = PSClient([n.uri for n in nodes], sender="w0")
+    truth = np.random.default_rng(8).normal(size=256).astype(np.float32)
+    writer.init({"w": np.zeros(256, np.float32)})
+    writer.push({"w": truth})
+    for _ in range(2):  # second pull folds the residual back in
+        got = writer.pull()["w"]
+    step = float(np.abs(truth).max()) / 127
+    np.testing.assert_allclose(got, truth, atol=2 * step)
+    writer.close()
+
+
+# ------------------------------------------------------------- serving ulp
+def test_serving_wire_bf16_ulp_contract(tmp_path, monkeypatch):
+    """Default serving stays bit-identical; WH_SERVE_WIRE=bf16 scores
+    bit-match the trainer's own margins over bf16-rounded weight rows
+    — the documented ulp contract — and fetch replies are exactly the
+    bf16-rounded rows."""
+    jnp = pytest.importorskip("jax.numpy")  # noqa: F841 - jax presence
+    from wormhole_tpu.data.rowblock import RowBlock
+    from wormhole_tpu.models.linear import LinearConfig, LinearLearner
+    from wormhole_tpu.parallel.mesh import make_mesh
+    from wormhole_tpu.serving import LinearScorer, ModelServer, Router
+    from wormhole_tpu.utils import manifest as _manifest
+
+    rng = np.random.default_rng(9)
+
+    def blk(n):
+        counts = rng.integers(1, 12, size=n)
+        offset = np.zeros(n + 1, np.int64)
+        offset[1:] = np.cumsum(counts)
+        return RowBlock(
+            label=np.zeros(n, np.float32),
+            offset=offset,
+            index=rng.integers(0, 1 << 62, size=int(offset[-1]),
+                               dtype=np.int64).astype(np.uint64),
+            value=rng.normal(size=int(offset[-1])).astype(np.float32))
+
+    cfg = LinearConfig(minibatch=64, num_buckets=1 << 12, nnz_per_row=16)
+    learner = LinearLearner(cfg, make_mesh(num_data=1, num_model=1))
+    train = blk(64)
+    train.label[:] = (rng.random(64) > 0.5).astype(np.float32)
+    for _ in range(3):
+        learner.train_batch(train)
+    base = str(tmp_path / "srv")
+    _manifest.write_snapshot_set(
+        base, {k: np.asarray(v) for k, v in learner.store.state.items()},
+        world=2)
+    servers = [ModelServer(r, 2, base) for r in range(2)]
+    for s in servers:
+        s.serve()
+    query = blk(50)
+    try:
+        for mode in ("fetch", "score"):
+            # unique sender per router: the shards' reply cache is
+            # keyed (sender, seq) and these routers share live shards
+            r_raw = Router([s.uri for s in servers], LinearScorer(cfg),
+                           mode=mode, sender=f"raw-{mode}")
+            ref, _ = r_raw.predict_block(query)
+            r_raw.close()
+            # default: bit-identical to the trainer's own predict
+            np.testing.assert_array_equal(
+                ref, np.asarray(learner.predict_batch(query))[:50])
+
+            monkeypatch.setenv("WH_SERVE_WIRE", "bf16")
+            r_q = Router([s.uri for s in servers], LinearScorer(cfg),
+                         mode=mode, sender=f"q-{mode}")
+            assert r_q.serve_wire == "bf16"
+            got, _ = r_q.predict_block(query)
+            r_q.close()
+            monkeypatch.delenv("WH_SERVE_WIRE")
+            if mode == "fetch":
+                # the pinned contract: fetched rows are bf16-rounded
+                # at the wire (ONE rounding), so scores == the scorer
+                # run over bf16-rounded weight rows, bit for bit
+                scorer = LinearScorer(cfg)
+                packed = scorer.pack(query)
+                full = {k: np.asarray(v)
+                        for k, v in learner.store.state.items()}
+                rows = {k: _bf16f(full[k][packed.keys[k]])
+                        for k in scorer.tables}
+                want = scorer.score(packed, rows)
+                np.testing.assert_array_equal(got,
+                                              np.asarray(want)[:50])
+            # score mode rounds the per-shard partial margins instead;
+            # both modes stay within bf16 relative error of raw scores
+            denom = np.maximum(np.abs(ref), 1e-6)
+            assert float(np.max(np.abs(got - ref) / denom)) < 0.05
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_serve_wire_knob_validation(monkeypatch):
+    from wormhole_tpu.serving.router import Router
+    monkeypatch.setenv("WH_SERVE_WIRE", "int8")
+    with pytest.raises(ValueError, match="WH_SERVE_WIRE"):
+        Router.__new__(Router).__init__(["tcp://127.0.0.1:1"], None)
+
+
+# ------------------------------------------------------------ BSP plane
+def _bsp_ring():
+    from wormhole_tpu.runtime.tracker import Scheduler, SchedulerClient
+    from wormhole_tpu.runtime.allreduce import BspWorker
+    sched = Scheduler("127.0.0.1", 0, node_timeout=10.0)
+    sched.serve()
+    made = []
+
+    def make(rank, world, **kw):
+        c = SchedulerClient(sched.uri, f"worker-{rank}")
+        c.register()
+        w = BspWorker(rank, world, c, step_timeout=0.5, retry_sec=20.0,
+                      **kw)
+        made.append(w)
+        return w
+
+    def close():
+        for w in made:
+            w.close()
+        sched.stop()
+
+    return make, close
+
+
+def _run_ranks(fns):
+    results = [None] * len(fns)
+    errors = []
+
+    def runner(i, fn):
+        try:
+            results[i] = fn()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=runner, args=(i, f))
+          for i, f in enumerate(fns)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    if errors:
+        raise errors[0]
+    return results
+
+
+def test_bsp_quantized_allreduce_cross_rank_bit_identical():
+    """With the codec on, every rank must still reconstruct the SAME
+    bits (the allgather leg ships bf16, idempotent under re-rounding)
+    and the sum stays within quantization error of exact."""
+    make, close = _bsp_ring()
+    try:
+        world = 3
+        comms = _run_ranks([lambda r=r: make(r, world, wire="int8")
+                            for r in range(world)])
+        rng = np.random.default_rng(10)
+        xs = [rng.normal(size=5000).astype(np.float32)
+              for _ in range(world)]
+        outs = _run_ranks([lambda c=c, x=x: c.allreduce(x)
+                           for c, x in zip(comms, xs)])
+        for o in outs[1:]:
+            np.testing.assert_array_equal(outs[0], o)
+        exact = np.sum(xs, axis=0)
+        step = float(np.abs(exact).max())
+        np.testing.assert_allclose(outs[0], exact,
+                                   atol=world * step / 64)
+    finally:
+        close()
+
+
+def test_bsp_small_payloads_stay_raw():
+    """Scalars and tiny arrays (loss sums) must never quantize — the
+    _WIRE_MIN_ELEMS floor keeps them exact."""
+    make, close = _bsp_ring()
+    try:
+        world = 2
+        comms = _run_ranks([lambda r=r: make(r, world, wire="int4")
+                            for r in range(world)])
+        outs = _run_ranks(
+            [lambda c=c, v=v: c.allreduce(np.float32(v))
+             for c, v in zip(comms, [1.5, 2.25])])
+        for o in outs:
+            assert float(o) == 3.75  # exact, not quantized
+    finally:
+        close()
+
+
+def test_bsp_recovered_run_bit_identical_with_codec(monkeypatch):
+    """The acceptance bar: a respawned rank replaying completed
+    collectives from the survivor's result cache gets bit-identical
+    arrays WITH the codec armed — stateless chunk quantization means a
+    replayed round serializes the same bytes."""
+    make, close = _bsp_ring()
+    try:
+        world = 2
+        c0, c1 = _run_ranks([lambda r=r: make(r, world, wire="int8")
+                             for r in range(world)])
+        rng = np.random.default_rng(11)
+        xs0 = [rng.normal(size=4096).astype(np.float32)
+               for _ in range(2)]
+        xs1 = [rng.normal(size=4096).astype(np.float32)
+               for _ in range(2)]
+        r0, r1 = _run_ranks([
+            lambda: [c0.allreduce(x) for x in xs0],
+            lambda: [c1.allreduce(x) for x in xs1]])
+        assert np.array_equal(r0[0], r1[0])
+        c1.close()  # rank 1 dies before any checkpoint
+
+        monkeypatch.setenv("WH_RESTORE_EPOCH", "1")
+        c1b = make(1, world, wire="int8")
+        garbage = np.full(4096, -999.0, np.float32)
+        replayed = [c1b.allreduce(garbage) for _ in range(2)]
+        assert np.array_equal(replayed[0], r0[0])
+        assert np.array_equal(replayed[1], r0[1])
+    finally:
+        close()
+
+
+# ------------------------------------------------------------- wire lab
+@pytest.mark.slow
+def test_wire_lab_runs_and_reports():
+    import json
+    import sys
+    sys.path.insert(0, "tools")
+    import wire_lab  # noqa: E402
+    import io
+    from contextlib import redirect_stdout
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = wire_lab.main(["--n", "4096", "--rounds", "4",
+                            "--reps", "1", "--json"])
+    assert rc == 0
+    rows = {json.loads(l)["stage"]: json.loads(l)
+            for l in out.getvalue().splitlines()}
+    # every encoding benchmark present, ratios sane (1-D int forms
+    # carry one f32 scale per 64-element group: +1/16 of raw f32)
+    for enc, ratio in (("bf16", 0.5), ("int8", 0.25 + 1 / 64),
+                       ("int4", 0.125 + 1 / 64)):
+        assert rows[f"enc_{enc}_1d"]["ratio"] == pytest.approx(
+            ratio, abs=0.01)
+    # EF strictly improves the accumulated error for both int widths
+    for enc in ("int8", "int4"):
+        assert (rows[f"ef_{enc}_on"]["rel_err"]
+                < rows[f"ef_{enc}_off"]["rel_err"])
+    assert rows["comp_bf16_bshuf"]["comp"] == "bshuf+zlib"

@@ -69,6 +69,18 @@ A third lab rides the same harness:
                1` tier plus an in-process router driver that must see
                ZERO failed predict requests throughout.
 
+A fifth lab rides the PS matrix with the wire codec armed:
+
+  --codec      the wire-codec parity drill: the PS kill/reset matrix
+               with WH_WIRE=int8 + error-feedback + byte-shuffle
+               framing on every connection. Verdicts compare against
+               the RAW-wire unfaulted baseline — the codec must hold
+               convergence parity through server kills and resets, and
+               the net:reset run keeps the un-deduped-replay bound
+               (a replayed push ships the same pre-quantized bytes and
+               must dup-ack; a fresh apply would double-count an EF
+               residual).
+
 A fourth lab targets the control plane itself:
 
   --sched      the scheduler-kill drill (docs/distributed.md,
@@ -235,12 +247,18 @@ def run_job(conf: str, spec: str, workers: int, servers: int,
             restarts: int, timeout: float,
             obs_dir: str | None = None,
             async_sync: bool = True,
-            plane: str = "tcp"
+            plane: str = "tcp",
+            extra_env: dict | None = None
             ) -> tuple[int, str, float, dict | None]:
     env = dict(os.environ, PYTHONPATH=REPO)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("WH_FAULT_SPEC", None)
     env.pop("WH_OBS_DIR", None)
+    # wire-codec knobs are per-scenario (the --codec drill passes them
+    # via extra_env); ambient values must not leak into baselines
+    for k in ("WH_WIRE", "WH_WIRE_EF", "WH_WIRE_COMP"):
+        env.pop(k, None)
+    env.update(extra_env or {})
     # the matrix exercises recovery at the PRODUCTION operating point:
     # async overlapped sync + key caching on (--sync-mode turns it off)
     env["WH_ASYNC_SYNC"] = "1" if async_sync else "0"
@@ -1160,6 +1178,133 @@ max_delay = 1
     return worst if worst != 1 else 1
 
 
+def _ps_scratch(args) -> tuple[str, str]:
+    """Scratch dir with synthetic libsvm parts + the difacto conf every
+    PS-side matrix runs (the hot plane adds model sharding so the
+    scenario exercises the real sharded gather/scatter path)."""
+    scratch = tempfile.mkdtemp(prefix="wh_chaos_")
+    for i in range(2):
+        synth_libsvm(os.path.join(scratch, f"train-{i}.libsvm"),
+                     args.rows, seed=i)
+    synth_libsvm(os.path.join(scratch, "val.libsvm"), args.rows, seed=9)
+    conf = os.path.join(scratch, "chaos.conf")
+    shards = ("model_shards = 2\n"
+              if getattr(args, "plane", "tcp") == "hot" else "")
+    with open(conf, "w") as fh:
+        fh.write(f"""
+train_data = "{scratch}/train-.*"
+val_data = "{scratch}/val.libsvm"
+algo = ftrl
+dim = 4
+threshold = 2
+lambda_l1 = 0.5
+minibatch = 128
+num_buckets = 16384
+v_buckets = 4096
+max_data_pass = {args.passes}
+max_delay = 1
+{shards}""")
+    return scratch, conf
+
+
+# --codec drill: the same PS faults with the wire codec at its full
+# operating point — int8 error-feedback deltas on push AND pull plus
+# byte-shuffle framing — judged for convergence PARITY against the
+# RAW-wire unfaulted baseline, not just self-consistency
+CODEC_ENV = {"WH_WIRE": "int8", "WH_WIRE_EF": "1",
+             "WH_WIRE_COMP": "bshuf"}
+CODEC_SPECS = ["", "server:0:kill@push:30", "server:0:kill@pull:25",
+               "net:reset:after_frames=50"]
+
+
+def codec_matrix(args) -> int:
+    """--codec: convergence-parity drill for WH_WIRE=int8 + EF. The
+    baseline is the RAW-wire unfaulted run; every codec scenario
+    (clean, server killed mid-push, server killed mid-pull, connection
+    reset) must land its final logloss within --tol of that baseline.
+    The net:reset scenario keeps the un-deduped-replay bound: a
+    journaled push replays the SAME pre-quantized QuantRows bytes and
+    must dup-ack on the seq fence — an extra fresh apply would be a
+    double-counted EF residual, which is exactly the way quantization
+    could silently break the exactly-once contract."""
+    scratch, conf = _ps_scratch(args)
+    print(f"[chaos] codec drill scratch={scratch} "
+          f"wire=int8 ef=1 comp=bshuf workers={args.workers} "
+          f"servers={args.servers}")
+
+    rc, out, dt, base_report = run_job(
+        conf, "", args.workers, args.servers, args.restarts,
+        args.timeout, obs_dir=os.path.join(scratch, "obs-raw"),
+        async_sync=not args.sync_mode)
+    base = final_logloss(out)
+    if rc != 0 or base is None:
+        print(out[-4000:])
+        print(f"[chaos] raw-wire baseline FAILED rc={rc} — nothing to "
+              "compare against; fix the clean path first")
+        return 2
+    base_m = report_metrics(base_report)
+    print(f"[chaos] raw-wire baseline: logloss={base:.5f} ({dt:.0f}s)")
+
+    rows, worst = [], 0
+    for i, spec in enumerate(CODEC_SPECS):
+        name = spec or "codec-clean"
+        rc, out, dt, report = run_job(
+            conf, spec, args.workers, args.servers, args.restarts,
+            args.timeout,
+            obs_dir=os.path.join(scratch, f"obs-codec-{i}"),
+            async_sync=not args.sync_mode,
+            extra_env=dict(CODEC_ENV))
+        ll = final_logloss(out)
+        m = report_metrics(report)
+        undeduped = m["journal_replays"] - m["replay_dedup_hits"]
+        if rc != 0 or ll is None:
+            verdict, detail = "FAILED", f"rc={rc} logloss={ll}"
+            worst = max(worst, 1)
+            tail = "\n".join(out.splitlines()[-12:])
+            detail += "\n    " + tail.replace("\n", "\n    ")
+        elif abs(ll - base) > args.tol:
+            # quantized-run drift past tolerance vs the RAW baseline is
+            # the codec losing information EF was supposed to recover
+            verdict = "SILENT-CORRUPTION"
+            detail = (f"logloss={ll:.5f} "
+                      f"drift={abs(ll - base):.5f} vs raw wire")
+            worst = max(worst, 3)
+        elif report is not None and "reset" in spec \
+                and undeduped > m["ps_retries"]:
+            verdict = "SILENT-CORRUPTION"
+            detail = (f"logloss={ll:.5f} but {undeduped} un-deduped "
+                      f"replays exceed {m['ps_retries']} reconnects — "
+                      "a replayed push re-applied quantized state")
+            worst = max(worst, 3)
+        else:
+            verdict = "survived"
+            detail = f"logloss={ll:.5f} drift={abs(ll - base):.5f}"
+            if spec and ("kill" in spec or "reset" in spec) \
+                    and not fault_fired(out):
+                verdict = "survived (fault never fired!)"
+            elif report is not None and "kill" in spec and not (
+                    m["server_restores"] or m["server_recoveries"]
+                    or m["ps_retries"]):
+                verdict = "survived (no recovery observed!)"
+        deltas = metric_deltas(m, base_m) if report is not None \
+            else "(no run_report.json)"
+        rows.append((name, verdict, detail, dt, deltas))
+        print(f"[chaos] {name}: {verdict} "
+              f"({detail.splitlines()[0]}, {dt:.0f}s)")
+        print(f"[chaos]   metrics vs raw baseline: {deltas}")
+
+    print(f"\n{'scenario':<30} {'verdict':<18} {'sec':>5}")
+    for name, verdict, detail, dt, deltas in rows:
+        print(f"{name:<30} {verdict:<18} {dt:>5.0f}")
+        print(f"    {detail.splitlines()[0]}")
+        print(f"    {deltas}")
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+    return worst if worst != 1 else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fault-injection matrix for the recovery paths")
@@ -1200,6 +1345,15 @@ def main(argv=None) -> int:
                          "replay + exactly-once RPC fence must carry "
                          "every run to convergence parity with zero "
                          "retry give-ups and zero failed predicts")
+    ap.add_argument("--codec", action="store_true",
+                    help="run the wire-codec drill instead of a fault "
+                         "matrix: the PS kill/reset scenarios with "
+                         "WH_WIRE=int8 error-feedback quantization and "
+                         "byte-shuffle framing on, judged for "
+                         "convergence parity against the RAW-wire "
+                         "unfaulted baseline (and for the exactly-once "
+                         "replay bound — a retried push must never "
+                         "double-apply an EF residual)")
     ap.add_argument("--sync-mode", action="store_true",
                     help="run with WH_ASYNC_SYNC=0 WH_KEYCACHE=0 (the "
                          "pre-overlap synchronous plane); default is "
@@ -1231,6 +1385,9 @@ def main(argv=None) -> int:
         # all four matrices inherit the profiler arm from here
         os.environ["WH_PROF"] = "1"
 
+    if args.codec:
+        args.workers = args.workers or 2
+        return codec_matrix(args)
     if args.elastic:
         return elastic_matrix(args)
     if args.sched:
@@ -1246,29 +1403,7 @@ def main(argv=None) -> int:
     args.specs = args.specs if args.specs is not None else (
         HOT_SPECS if args.plane == "hot" else DEFAULT_SPECS)
 
-    scratch = tempfile.mkdtemp(prefix="wh_chaos_")
-    for i in range(2):
-        synth_libsvm(os.path.join(scratch, f"train-{i}.libsvm"),
-                     args.rows, seed=i)
-    synth_libsvm(os.path.join(scratch, "val.libsvm"), args.rows, seed=9)
-    conf = os.path.join(scratch, "chaos.conf")
-    # hot plane: shard the device tables over the forced host mesh so
-    # the scenario exercises the real sharded gather/scatter path
-    shards = "model_shards = 2\n" if args.plane == "hot" else ""
-    with open(conf, "w") as fh:
-        fh.write(f"""
-train_data = "{scratch}/train-.*"
-val_data = "{scratch}/val.libsvm"
-algo = ftrl
-dim = 4
-threshold = 2
-lambda_l1 = 0.5
-minibatch = 128
-num_buckets = 16384
-v_buckets = 4096
-max_data_pass = {args.passes}
-max_delay = 1
-{shards}""")
+    scratch, conf = _ps_scratch(args)
 
     restarts = 0 if args.no_recovery else args.restarts
     print(f"[chaos] scratch={scratch} plane={args.plane} "

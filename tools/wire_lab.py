@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Wire-codec microbench: per-encoding cost, ratio, and EF convergence.
+
+Three row families, one per question the codec has to answer:
+
+* ``enc_*`` / ``dec_*`` — encode/decode throughput (ns/byte of the RAW
+  payload) and wire ratio (wire bytes / raw bytes) for every
+  WH_WIRE encoding over 1-D (scalar-scale) and 2-D (per-row-scale)
+  shapes. This is the "is quantization cheaper than the bytes it
+  saves" table; PERF.md's wire rows come from here.
+* ``comp_*`` — the negotiated frame-compression modes (zlib,
+  bshuf+zlib) over smooth gradient-like data: ratio after the byte
+  plane shuffle vs plain zlib-1, and the encode cost each adds. The
+  shuffle groups each float's exponent bytes together, which is where
+  the compressibility of training deltas actually lives.
+* ``ef_*`` — error-feedback convergence over synced rounds: a sparse
+  delta stream is quantized with and without the EF accumulator and
+  the dequantized stream is summed like a PS shard would. Without EF
+  the per-round bias random-walks; with EF the accumulated error
+  stays bounded by one quantization step and the residual norm
+  plateaus. The emitted `rel_err` pair is the convergence-safety
+  argument for WH_WIRE=int8/int4 in numbers.
+
+CPU-only (pure numpy — no jax import); tests/test_wire_codec.py wires
+it into the slow tier.
+
+Usage: python tools/wire_lab.py [--n N] [--rounds N] [--reps N] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from wormhole_tpu.runtime.net import (
+    EFQuant, WIRE_ENCODINGS, _decode, _encode, quantize_rows,
+)
+
+
+def _time(fn, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _enc_dec_rows(args, emit):
+    rng = np.random.default_rng(0)
+    shapes = {"1d": (args.n,), "2d": (args.n // 8, 8)}
+    for tag, shape in shapes.items():
+        a = rng.standard_normal(shape).astype(np.float32)
+        raw_b = a.nbytes
+        for enc in WIRE_ENCODINGS:
+            if enc == "raw":
+                mk = lambda: _encode(a)
+            else:
+                mk = lambda e=enc: _encode(quantize_rows(a, e))
+            meta, buf = mk()
+            dt_e = _time(mk, args.reps)
+            dt_d = _time(lambda: _decode(meta, buf), args.reps)
+            err = (0.0 if enc == "raw" else float(
+                np.max(np.abs(_decode(meta, buf) - a))
+                / max(float(np.max(np.abs(a))), 1e-30)))
+            emit(f"enc_{enc}_{tag}", 1e9 * dt_e / raw_b,
+                 dec_ns_per_byte=round(1e9 * dt_d / raw_b, 3),
+                 ratio=round(meta["nbytes"] / raw_b, 4),
+                 max_rel_err=round(err, 5))
+
+
+def _comp_rows(args, emit):
+    # smooth, gradient-like data: neighboring values share exponent
+    # bytes, which is the structure the byte shuffle exposes to zlib
+    rng = np.random.default_rng(1)
+    a = np.cumsum(rng.standard_normal(args.n).astype(np.float32) * 1e-3)
+    raw_b = a.nbytes
+    for enc in ("raw", "bf16"):
+        payload = a if enc == "raw" else quantize_rows(a, enc)
+        for mode in ("zlib", "bshuf"):
+            mk = lambda p=payload, m=mode: _encode(p, compress=m)
+            meta, buf = mk()
+            dt = _time(mk, args.reps)
+            emit(f"comp_{enc}_{mode}", 1e9 * dt / raw_b,
+                 ratio=round(meta["nbytes"] / raw_b, 4),
+                 comp=meta.get("comp", "none"))
+
+
+def _ef_rows(args, emit):
+    """Sum a quantized sparse delta stream the way a PS shard would and
+    compare against the exact f32 sum — with and without EF."""
+    rng = np.random.default_rng(2)
+    space = args.n
+    for enc in ("int8", "int4"):
+        for use_ef in (True, False):
+            efq = EFQuant(enc) if use_ef else None
+            exact = np.zeros(space, np.float32)
+            applied = np.zeros(space, np.float32)
+            resid = 0.0
+            for _ in range(args.rounds):
+                idx = np.unique(rng.integers(0, space,
+                                             size=space // 2))
+                d = (rng.standard_normal(idx.size)
+                     .astype(np.float32) * 0.01)
+                exact[idx] += d
+                if efq is not None:
+                    qr = efq.apply(idx, d)
+                    resid = efq.resid_norm()
+                else:
+                    qr = quantize_rows(d, enc)
+                applied[idx] += qr.dequant()
+            rel = float(np.linalg.norm(applied - exact)
+                        / max(np.linalg.norm(exact), 1e-30))
+            emit(f"ef_{enc}_{'on' if use_ef else 'off'}", 0.0,
+                 rounds=args.rounds, rel_err=round(rel, 5),
+                 resid_norm=round(resid, 5))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=1 << 20,
+                    help="elements per payload (bench point: 1<<22)")
+    ap.add_argument("--rounds", type=int, default=16,
+                    help="synced rounds for the EF convergence rows")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timing repetitions (best-of)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per row instead of a table")
+    args = ap.parse_args(argv)
+
+    rows = []
+
+    def emit(stage, ns_per_byte, **kw):
+        rows.append(dict({"stage": stage,
+                          "enc_ns_per_byte": round(ns_per_byte, 3)},
+                         **kw))
+
+    _enc_dec_rows(args, emit)
+    _comp_rows(args, emit)
+    _ef_rows(args, emit)
+
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+    else:
+        print(f"{'stage':<18} {'ns/byte':>8}   detail")
+        for r in rows:
+            extra = " ".join(f"{k}={v}" for k, v in r.items()
+                             if k not in ("stage", "enc_ns_per_byte"))
+            print(f"{r['stage']:<18} {r['enc_ns_per_byte']:>8.3f}   {extra}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -359,6 +359,41 @@ declare_knob("WH_NET_COMPRESS", bool, False,
              "ends must enable it). Meant for the hot plane's cold-tier/"
              "snapshot path and cross-pod sync, where flush frames are "
              "large and rare.", group="ps")
+declare_knob("WH_WIRE", str, "raw",
+             "Value encoding on the parameter wire: 'raw' f32, 'bf16' "
+             "truncation, 'int8' / 'int4' absmax quantization (per-row "
+             "scales for 2-D tables, per-64-element group scales for "
+             "1-D). Applies to SyncedStore pushes (accumulator tables "
+             "with TableSpec.wire_cap floor at bf16), PS pull replies "
+             "(capped at bf16 — absolute-state refreshes need "
+             "per-element relative precision — and derived tables skip "
+             "the wire: the client recomputes w from the pulled z/n), "
+             "and BSP allreduce chunks; negotiated in hello with "
+             "legacy-bf16 fallback for old peers.", group="ps")
+declare_knob("WH_WIRE_EF", bool, True,
+             "Error feedback for quantized wire values: re-inject each "
+             "row's quantization error the next time it ships, making "
+             "int8/int4 streams unbiased over time. PS pushes get it via "
+             "the SyncedStore base algebra, pulls via server-side "
+             "per-sender residuals; the BSP plane quantizes statelessly "
+             "regardless (cross-round residuals would break replay "
+             "bit-identity). No effect under WH_WIRE=raw.", group="ps")
+declare_knob("WH_WIRE_COMP", str, "",
+             "Frame compression mode: '' off, 'zlib' (the WH_NET_COMPRESS "
+             "codec), 'bshuf' = byte-plane shuffle + zlib-6 (groups "
+             "same-significance bytes; wins on ratio and speed for float "
+             "tables, and sorted index vectors additionally ship "
+             "delta-encoded). Hello-negotiated: an old peer that only "
+             "acks zlib gets zlib, one that acks nothing gets raw "
+             "frames.",
+             group="ps")
+
+declare_knob("WH_WIRE_DEBUG", str, "",
+             "Wire-codec diagnostics to stderr: '1' prints each EFQuant "
+             "residual-store merge, '2' additionally prints a per-array "
+             "accounting line per sent frame (name, encoding, framing, "
+             "post-compression bytes) — the breakdown that attributes "
+             "bytes_per_sync to individual tables.", group="ps")
 
 declare_knob("WH_NET_MAX_INFLIGHT", int, 0,
              "Max requests a frame server (PS shard / serving shard) admits "
@@ -403,6 +438,13 @@ declare_knob("WH_SERVE_RETRY_SEC", float, 30.0,
              "Router-side retry window for a dead serving shard: how long "
              "predict fan-outs re-resolve and redial before a batch fails.",
              group="serve")
+declare_knob("WH_SERVE_WIRE", str, "raw",
+             "Serving reply encoding: 'raw' keeps the bit-identity "
+             "contract vs the trainer's predict_batch; 'bf16' truncates "
+             "fetch/score reply values (round-to-nearest-even) for half "
+             "the reply bytes, relaxing scores to a documented ulp "
+             "contract. Request-stamped, so retried frames replay "
+             "byte-identically either way.", group="serve")
 declare_knob("WH_SERVE_MODE", str, "auto",
              "Serving dataflow: 'fetch' ships weight rows to the router, "
              "'score' runs the shard-local fast path (partial margins "

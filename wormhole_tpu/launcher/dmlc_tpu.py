@@ -99,6 +99,8 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
            pass_env: tuple[str, ...] = ("JAX_PLATFORMS", "XLA_FLAGS",
                                         "PYTHONPATH", "WH_PS_PLANE",
                                         "WH_NET_COMPRESS",
+                                        "WH_WIRE", "WH_WIRE_EF",
+                                        "WH_WIRE_COMP", "WH_SERVE_WIRE",
                                         "WH_TRACE_SAMPLE",
                                         "WH_OBS_SCRAPE_SEC",
                                         "WH_OBS_SCRAPE_PORT",

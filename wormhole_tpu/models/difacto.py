@@ -96,10 +96,12 @@ def _tables_for(cfg: DifactoConfig) -> dict[str, TableSpec]:
     return {
         "w": TableSpec(),
         "z": TableSpec(),
-        "n": TableSpec(),
-        "cnt": TableSpec(dtype=jnp.float32),
+        # second-moment / count accumulators floor at bf16 on the push
+        # wire (huge-dynamic-range nonnegative deltas: see TableSpec)
+        "n": TableSpec(wire_cap="bf16"),
+        "cnt": TableSpec(dtype=jnp.float32, wire_cap="bf16"),
         "V": TableSpec(tail=(cfg.dim,), init=v_init),
-        "nV": TableSpec(tail=(cfg.dim,)),
+        "nV": TableSpec(tail=(cfg.dim,), wire_cap="bf16"),
     }
 
 
@@ -156,6 +158,12 @@ class _CombinedStore:
         out = set()
         for s in self.stores:
             out |= s.zero_init_names()
+        return out
+
+    def wire_cap_names(self):
+        out = set()
+        for s in self.stores:
+            out |= s.wire_cap_names()
         return out
 
     @property

@@ -208,9 +208,9 @@ def _tables_for(algo: str) -> dict[str, TableSpec]:
     t = {"w": TableSpec()}
     if algo == "ftrl":
         t["z"] = TableSpec()
-        t["n"] = TableSpec()
+        t["n"] = TableSpec(wire_cap="bf16")  # second moment: see TableSpec
     elif algo == "adagrad":
-        t["n"] = TableSpec()
+        t["n"] = TableSpec(wire_cap="bf16")
     return t
 
 

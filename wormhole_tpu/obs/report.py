@@ -172,6 +172,11 @@ def build(aggregate: dict, nodes=(), run_id=None,
         "keycache_invalidations": c.get("ps.keycache.invalidations", 0),
         "net_compress_bytes_in": c.get("net.compress.bytes_in", 0),
         "net_compress_bytes_out": c.get("net.compress.bytes_out", 0),
+        "wire_bytes_raw": c.get("wire.codec.bytes_raw", 0),
+        "wire_bytes_wire": c.get("wire.codec.bytes_wire", 0),
+        "wire_ef_resid_norm": g.get("wire.codec.ef_resid_norm", 0.0),
+        "bshuf_bytes_in": c.get("net.bshuf.bytes_in", 0),
+        "bshuf_bytes_out": c.get("net.bshuf.bytes_out", 0),
         "hot_plane_steps": c.get("ps.hot.steps", 0),
         "hot_plane_flushes": c.get("ps.hot.flushes", 0),
         "bsp_rounds": c.get("bsp.rounds", 0),
@@ -322,6 +327,12 @@ def format_lines(report: dict) -> list[str]:
         lines.append(
             f"  net compress: out={s['net_compress_bytes_out']}B "
             f"in={s['net_compress_bytes_in']}B")
+    if s.get("wire_bytes_raw"):
+        saved = s["wire_bytes_raw"] / max(s["wire_bytes_wire"], 1)
+        lines.append(
+            f"  wire codec: {s['wire_bytes_wire']}B on the wire for "
+            f"{s['wire_bytes_raw']}B of f32 values ({saved:.2f}x saved, "
+            f"ef_resid_norm={s['wire_ef_resid_norm']:.3g})")
     if s.get("hot_plane_steps") or s.get("hot_plane_flushes"):
         lines.append(
             f"  hot plane: steps={s['hot_plane_steps']} "

@@ -45,11 +45,25 @@ _JIT_MISSES = _obs.REGISTRY.counter("kv.jit_cache_misses")
 
 @dataclasses.dataclass
 class TableSpec:
-    """One named state table: shape = (num_buckets, *tail)."""
+    """One named state table: shape = (num_buckets, *tail).
+
+    `wire_cap` floors the wire encoding of this table's PUSH deltas:
+    "bf16" means WH_WIRE=int8/int4 still ships this table at bf16.
+    Second-moment / count accumulators (FTRL n, difacto n/cnt/nV) need
+    it: their per-sync deltas are nonnegative with huge dynamic range
+    (a hot bucket's n grows ~minibatch per sync while a cold bucket's
+    grows ~1), so an absmax group code quantizes the cold buckets at
+    the hot neighbor's granularity — mis-scaling their per-coordinate
+    learning rates, which error feedback cannot undo (EF repairs the
+    accumulated STATE over rounds, not the optimizer trajectory already
+    taken at the wrong rate). bf16's per-element relative precision
+    (~0.4%) is safe at any magnitude. Sign-mixed gradient-like streams
+    (z, V) keep the full int8/int4+EF treatment."""
 
     tail: tuple = ()
     dtype: object = jnp.float32
     init: Optional[Callable] = None  # (key, shape, dtype) -> array; 0 if None
+    wire_cap: str = ""  # "" (no floor) or "bf16"
 
 
 class KVStore:
@@ -203,6 +217,12 @@ class KVStore:
         creates these server-side from shape alone, with no array on the
         startup wire (runtime/ps_server.py init_from_specs)."""
         return {k for k, s in self.specs.items() if s.init is None}
+
+    def wire_cap_names(self) -> set[str]:
+        """Tables whose push deltas must never drop below bf16 on the
+        wire (see TableSpec.wire_cap) — read by SyncedStore's
+        _quantize_deltas."""
+        return {k for k, s in self.specs.items() if s.wire_cap}
 
     # -- host-side views ----------------------------------------------------
     def nnz(self, name: str = "w") -> int:

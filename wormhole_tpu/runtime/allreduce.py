@@ -38,10 +38,24 @@ the launcher's respawn supervision (PR 1) for process resurrection:
   fetching peers' cached results (bit-identical, no re-reduction), and
   falls back into the live ring once fetches miss everywhere.
 
+- **Wire codec (WH_WIRE).** Reduce-scatter chunk sends are quantized
+  STATELESSLY per chunk (bf16/int8/int4, per-64-element group scales —
+  a pure function of the chunk values, never of round history): cross-round EF state
+  cannot survive the fetch-replay contract, because a respawned rank
+  replays completed rounds from peers' result caches without advancing
+  any residuals while survivors' would have advanced. The allgather
+  phase always ships bf16 — bf16 rounding is IDEMPOTENT, so after the
+  owning rank self-rounds its reduced chunk once, every forwarding hop
+  re-encodes the same 16 bits and all ranks reconstruct bit-identical
+  results; recovered runs therefore stay bit-identical to fault-free
+  runs with the codec on. Chunks below _WIRE_MIN_ELEMS (solver-loss
+  scalars, small vectors) stay raw f32.
+
 Knobs (declared in config.py, group "bsp"): WH_BSP_STEP_TIMEOUT bounds
 one mailbox wait before re-polling the tracker generation;
 WH_BSP_RETRY_SEC bounds how long a blocked collective waits overall for
-a dead peer's respawn before failing the job.
+a dead peer's respawn before failing the job. WH_WIRE (group "ps")
+selects the chunk encoding above.
 """
 
 from __future__ import annotations
@@ -60,8 +74,8 @@ from wormhole_tpu.obs import metrics as _obs
 from wormhole_tpu.obs import trace as _trace
 from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime import retry as _retrylib
-from wormhole_tpu.runtime.net import (connect_with_retry, recv_frame,
-                                      send_frame)
+from wormhole_tpu.runtime.net import (connect_with_retry, quantize_rows,
+                                      recv_frame, send_frame)
 
 _ROUNDS = _obs.REGISTRY.counter("bsp.rounds")
 _RING_RETRIES = _obs.REGISTRY.counter("bsp.ring_retries")
@@ -73,6 +87,10 @@ _CKPT_S = _obs.REGISTRY.histogram("bsp.checkpoint_s")
 
 _OPS: dict[str, Callable] = {"sum": np.add, "max": np.maximum,
                              "min": np.minimum}
+
+# chunks smaller than this ship raw f32: quantizing a solver-loss
+# scalar would be all error and no savings (headers dominate anyway)
+_WIRE_MIN_ELEMS = 1024
 
 
 class _RoundAbort(Exception):
@@ -133,7 +151,8 @@ class BspWorker:
                  snapshot_dir: Optional[str] = None,
                  host: str = "127.0.0.1",
                  step_timeout: Optional[float] = None,
-                 retry_sec: Optional[float] = None):
+                 retry_sec: Optional[float] = None,
+                 wire: Optional[str] = None):
         self.rank = int(rank)
         self.world = int(world)
         self.client = client
@@ -143,6 +162,11 @@ class BspWorker:
                              else knob_value("WH_BSP_STEP_TIMEOUT"))
         self.retry_sec = (retry_sec if retry_sec is not None
                           else knob_value("WH_BSP_RETRY_SEC"))
+        # chunk wire encoding (WH_WIRE; see the module docstring for
+        # why the BSP plane quantizes statelessly and allgathers bf16)
+        w = (wire if wire is not None
+             else os.environ.get("WH_WIRE") or "raw").strip().lower()
+        self.wire_enc = w if w in ("bf16", "int8", "int4") else "raw"
         self.version = 0   # checkpoints completed
         self.seq = 0       # next collective's counter within the version
         self.gen = 0       # group membership generation (tracker-owned)
@@ -301,7 +325,9 @@ class BspWorker:
 
     # -- ring ----------------------------------------------------------------
     def _send_step(self, to: int, gen: int, key: tuple[int, int],
-                   t: int, chunk: np.ndarray, deadline: float) -> None:
+                   t: int, chunk, deadline: float) -> None:
+        # `chunk` is an ndarray or a pre-quantized net.QuantRows; every
+        # retry re-sends the SAME object, so the bytes never vary
         header = {"op": "bsp_step", "gen": gen, "ver": key[0],
                   "seq": key[1], "t": t, "src": self.rank}
         pace = min(0.2, self.step_timeout)
@@ -344,12 +370,32 @@ class BspWorker:
                     f"bsp rank {self.rank}: no step {t} of {key} from "
                     f"predecessor within {self.retry_sec:.0f}s")
 
+    def _wire_rs(self, chunk: np.ndarray):
+        """Reduce-scatter wire form of a chunk: the configured encoding
+        with grouped scales — a pure function of the chunk values, so a
+        retried round re-sends identical bytes. Small chunks stay raw."""
+        if self.wire_enc == "raw" or chunk.size < _WIRE_MIN_ELEMS:
+            return chunk
+        return quantize_rows(chunk, self.wire_enc)
+
+    def _wire_ag(self, chunk: np.ndarray):
+        """Allgather wire form: always bf16 when the codec is on. bf16
+        rounding is idempotent, so every forwarding hop re-encodes the
+        same 16 bits and all ranks reconstruct identical values."""
+        if self.wire_enc == "raw" or chunk.size < _WIRE_MIN_ELEMS:
+            return chunk
+        return quantize_rows(chunk, "bf16")
+
     def _ring_round(self, key: tuple[int, int], flat: np.ndarray,
                     combine: Callable) -> np.ndarray:
         """One ring reduce-scatter + allgather at the current generation.
         Chunk boundaries (np.array_split) and the local-then-incoming
         accumulation order are functions of (shape, world, rank) only, so
-        any retry or replay reproduces the result bit-for-bit."""
+        any retry or replay reproduces the result bit-for-bit. With the
+        wire codec on, the rank that finishes reducing a chunk rounds
+        its OWN copy to bf16 before the allgather — the same values
+        every other rank will decode off the wire — so the concatenated
+        result is bit-identical on all ranks."""
         gen0 = self.gen
         w, r = self.world, self.rank
         chunks = list(np.array_split(flat, w))
@@ -358,13 +404,19 @@ class BspWorker:
         for t in range(w - 1):  # reduce-scatter
             si = (r - t) % w
             ri = (r - t - 1) % w
-            self._send_step(succ, gen0, key, t, chunks[si], deadline)
+            self._send_step(succ, gen0, key, t, self._wire_rs(chunks[si]),
+                            deadline)
             got = self._wait_step(gen0, key, t, deadline)
             chunks[ri] = combine(chunks[ri], got)
+        own = (r + 1) % w  # the chunk this rank finished reducing
+        if (self.wire_enc != "raw"
+                and chunks[own].size >= _WIRE_MIN_ELEMS):
+            chunks[own] = quantize_rows(chunks[own], "bf16").dequant()
         for t in range(w - 1):  # allgather
             si = (r + 1 - t) % w
             ri = (r - t) % w
-            self._send_step(succ, gen0, key, w - 1 + t, chunks[si], deadline)
+            self._send_step(succ, gen0, key, w - 1 + t,
+                            self._wire_ag(chunks[si]), deadline)
             chunks[ri] = self._wait_step(gen0, key, w - 1 + t, deadline)
         return np.concatenate(chunks)
 

@@ -31,9 +31,30 @@ This module is the TPU build's cross-process equivalent:
 - Pushes are optionally quantized on the wire (fixed_bytes: 2 = bfloat16
   bits, 1 = int8 + scale — the FIXING_FLOAT/TRUNCATE filter parity,
   async_sgd.h:290-301) and optionally zlib-compressed (the
-  msg_compression filter, linear config.proto:123-133). The reference's
-  third filter, KEY_CACHING, avoids resending identical key lists;
-  `WH_KEYCACHE=1` enables its analog here: frames carry a blake2b
+  msg_compression filter, linear config.proto:123-133).
+- **Wire codec v2** (`WH_WIRE={raw,bf16,int8,int4}`, `WH_WIRE_EF`,
+  `WH_WIRE_COMP={,zlib,bshuf}`): value quantization on BOTH directions
+  with sender-side error feedback. Pushes quantize each sync's delta
+  rows ONCE (SyncedStore snapshot time) into `net.QuantRows` — per-row
+  scales for 2-D tables, per-64-element group scales for 1-D (a scalar
+  scale over a skewed compacted row vector flattens everything but the
+  hottest row to zero and diverges FTRL) — with an `EFQuant` residual
+  accumulator per table (transmit Q(delta + r), keep
+  r <- (delta + r) - Q(.)), so low-bit value streams stay unbiased over
+  time; journal replays and need_keys resends reuse the SAME QuantRows,
+  so the seq-fenced retry can never re-advance (double-apply) a
+  residual. Versioned pull replies are quantized server-side with a
+  per-(sender, table) EFQuant — pulls are absolute refreshes, so a lost
+  reply self-corrects on the next one — and invalidated with the key
+  caches on restore; pull replies cap at bf16 (absolute-state refreshes
+  need per-element relative precision — absmax codes err relative to
+  the hottest group neighbor and diverge skewed FTRL tables). Everything is hello-negotiated per connection: the
+  client offers `wire`/`wire_comp`, the server acks what it can decode,
+  and an un-acked (older) peer silently degrades to the legacy scalar
+  fixed_bytes forms and raw framing. `wire_comp=bshuf` frames eligible
+  buffers with a byte-plane shuffle + zlib-1 (`comp="bshuf+zlib"`).
+- The reference's third filter, KEY_CACHING, avoids resending
+  identical key lists; `WH_KEYCACHE=1` enables its analog here: frames carry a blake2b
   digest of each group's sorted key vector, servers cache key lists per
   (sender, digest), and a repeated touched set (the common case on
   epoch 2+ under the pack cache) ships digest + values only, with a
@@ -80,9 +101,10 @@ from wormhole_tpu.runtime import faults
 from wormhole_tpu.runtime import overload as _overload
 from wormhole_tpu.runtime import retry as _retrylib
 from wormhole_tpu.runtime.net import (  # noqa: F401  (re-exported: the wire
-    _COMPRESS_MIN, _decode, _encode, _read_exact, InflightGate,
-    busy_backoff, busy_reply, connect_with_retry,
-    key_digest, recv_frame, send_frame)  # format moved to net.py so fault
+    _COMPRESS_MIN, _decode, _encode, _read_exact, EFQuant, InflightGate,
+    QuantRows, WIRE_COMP_MODES, WIRE_ENCODINGS, busy_backoff, busy_reply,
+    connect_with_retry, key_digest, quantize_rows,
+    recv_frame, send_frame)  # format moved to net.py so fault
 # injection can hook frame send/recv for every net user; tests and tools
 # keep importing the names from here.
 
@@ -151,6 +173,19 @@ def _idx_name(rows: int) -> str:
     return f"idx:{rows}"
 
 
+def ftrl_prox_rows(spec: dict, z: np.ndarray,
+                   n: np.ndarray) -> np.ndarray:
+    """The 'ftrl_prox' derived-table rule: w = prox(z, n) with the
+    spec's lr/elastic-net constants. ONE definition shared by the
+    server's dirty-row recompute (_recompute_derived) and the client's
+    pull-side reconstruction (SyncedStore._fill_derived), so both ends
+    of the wire derive identical values from identical sources."""
+    eta = (spec["lr_beta"] + np.sqrt(n)) / spec["lr_eta"]
+    mag = np.maximum(np.abs(z) - spec["lambda_l1"], 0.0)
+    return (np.sign(-z) * mag / (eta + spec["lambda_l2"])
+            ).astype(np.float32)
+
+
 # ---------------------------------------------------------------- server
 class _PSHandler(socketserver.StreamRequestHandler):
     def handle(self):
@@ -177,7 +212,13 @@ class _PSHandler(socketserver.StreamRequestHandler):
         # hello-negotiated: it turns on only after a hello carrying
         # net_compress=1 lands while this server has the knob set, and
         # the ack in the reply is what arms the client side — either end
-        # left at the default keeps the whole connection uncompressed
+        # left at the default keeps the whole connection uncompressed.
+        # Wire-codec negotiation rides the same hello: `wire` asks "can
+        # you decode QuantRows encodings / quantize pull replies" (acked
+        # unconditionally — capability is the codebase, not a knob) and
+        # `wire_comp` latches the negotiated frame-compression mode
+        # ("zlib" / "bshuf") for every frame both ways; fc holds
+        # False / True(zlib) / "zlib" / "bshuf" and feeds send_frame.
         fc = False
         while True:
             got = recv_frame(self.rfile)
@@ -215,10 +256,16 @@ class _PSHandler(socketserver.StreamRequestHandler):
                                                               arrays)
             finally:
                 node._gate.leave(op, time.perf_counter() - t_in)
-            if (header.get("op") == "hello" and header.get("net_compress")
-                    and node.net_compress):
-                fc = True
-                resp_header["net_compress"] = 1
+            if header.get("op") == "hello":
+                if header.get("net_compress") and node.net_compress:
+                    fc = True
+                    resp_header["net_compress"] = 1
+                if header.get("wire"):
+                    resp_header["wire"] = 1
+                wc = header.get("wire_comp")
+                if wc in ("zlib", "bshuf"):
+                    fc = wc
+                    resp_header["wire_comp"] = wc
             # every reply carries the server's restore epoch so clients
             # detect a respawned (rolled-back) server on any op
             resp_header.setdefault("epoch", node.epoch)
@@ -315,6 +362,11 @@ class ServerNode:
         # stale assumption harmless.
         self._kc_idx: dict[str, collections.OrderedDict] = {}
         self._kc_known: dict[str, collections.OrderedDict] = {}
+        # pull-side error feedback (wire codec v2): per-sender,
+        # per-table residual accumulators for quantized pull replies;
+        # invalidated with the key caches on restore (a rolled-back
+        # shard's residuals describe values that no longer exist)
+        self._efq: dict[str, dict[str, EFQuant]] = {}
         # async snapshot state: base path, cadence, clock of the last
         # written snapshot (skip when nothing changed), writer thread
         self._snap_base: Optional[str] = None
@@ -537,15 +589,32 @@ class ServerNode:
                     # both the derived recompute and the O(shard rows)
                     # version scans (ADVICE r3 — at 2^26 buckets each
                     # scan walks a 64M-element array); reply shape
-                    # matches the scan path (empty idx + empty rows)
+                    # matches the scan path (empty idx + empty rows),
+                    # INCLUDING the derived-table skip — a quiet shard
+                    # that ships an empty `w` part while a dirty peer
+                    # honors the skip would leave the client's merged
+                    # `w` shorter than its merged index
+                    skip = {k for k in (header.get("skip") or ())
+                            if k in self.derived}
                     for g in self._ver:
                         out[_idx_name(g)] = np.empty(0, np.int64)
                     for k in self.tables:
+                        if k in skip:
+                            continue
                         out[k] = self.tables[k][:0]
                     return {"ok": True, "clock": self.clock}, out
                 self._recompute_derived()
                 sender = header.get("sender")
                 use_kc = bool(header.get("kc")) and sender is not None
+                wire = header.get("wire")
+                if wire not in ("bf16", "int8", "int4"):
+                    wire = None
+                # derived-table wire skip: a client that can recompute a
+                # derived table from its pulled sources asks us to omit
+                # it. Honored ONLY for tables in self.derived — additive
+                # state can never be silently dropped by a bad request.
+                skip = {k for k in (header.get("skip") or ())
+                        if k in self.derived}
                 kdig_hit: dict[str, str] = {}
                 kdig_full: dict[str, str] = {}
                 for g, ver in self._ver.items():
@@ -568,7 +637,14 @@ class ServerNode:
                         out[_idx_name(g)] = idx
                     for k, rows in self.full_rows.items():
                         if rows == g:
-                            out[k] = self.tables[k][idx]
+                            if k in skip:
+                                continue
+                            vals = self.tables[k][idx]
+                            if wire is not None and idx.size:
+                                vals = self._wire_pull(sender, k, idx,
+                                                       vals, wire,
+                                                       header)
+                            out[k] = vals
                 resp = {"ok": True, "clock": self.clock}
                 if kdig_hit:
                     resp["kdig"] = kdig_hit
@@ -768,6 +844,19 @@ class ServerNode:
             known.popitem(last=False)
         return dig, False
 
+    def _wire_pull(self, sender, k: str, idx: np.ndarray,  # wormlint: guarded-by(self._lock)
+                   vals: np.ndarray, wire: str, header: dict) -> QuantRows:
+        """Quantize a versioned-pull reply's rows (wire codec v2, lock
+        held). With `wire_ef` and a named sender the per-(sender, table)
+        EFQuant folds prior quantization error of these rows back in;
+        pulls are absolute-value refreshes, so a reply lost on the wire
+        is corrected by the sender's next pull, never double-counted."""
+        if header.get("wire_ef") and sender is not None:
+            efq = self._efq.setdefault(sender, {}).setdefault(
+                k, EFQuant(wire))
+            return efq.apply(idx, vals)
+        return quantize_rows(vals, wire)
+
     def _kc_invalidate(self) -> None:  # wormlint: guarded-by(self._lock)
         """Recovery-path cache discard (snapshot restore / checkpoint
         load): a rolled-back server must not resolve pre-crash digests
@@ -776,6 +865,8 @@ class ServerNode:
             _KC_INVALIDATIONS.inc()
         self._kc_idx = {}
         self._kc_known = {}
+        # pull-EF residuals roll back with the tables they corrected
+        self._efq = {}
 
     def _recompute_derived(self) -> None:  # wormlint: guarded-by(self._lock)
         """Recompute derived tables from their additive sources over the
@@ -798,12 +889,8 @@ class ServerNode:
                 u = np.unique(np.concatenate(dirty))
                 if u.size == 0:
                     continue
-            z, n = self.tables["z"][u], self.tables["n"][u]
-            eta = (spec["lr_beta"] + np.sqrt(n)) / spec["lr_eta"]
-            mag = np.maximum(np.abs(z) - spec["lambda_l1"], 0.0)
-            self.tables[k][u] = (np.sign(-z) * mag
-                                 / (eta + spec["lambda_l2"])
-                                 ).astype(np.float32)
+            self.tables[k][u] = ftrl_prox_rows(
+                spec, self.tables["z"][u], self.tables["n"][u])
         for g in self._dirty:
             self._dirty[g] = []
 
@@ -1144,9 +1231,31 @@ class PSClient:
         # hello-negotiated frame compression (WH_NET_COMPRESS): when the
         # knob is set here, every fresh connection's hello offers it and
         # _fc[r] latches the server's ack — from then on every frame to
-        # that server ships zlib'd (replies ride the server's fc flag)
+        # that server ships zlib'd (replies ride the server's fc flag).
+        # _fc holds False / True(zlib) / "zlib" / "bshuf" — whatever
+        # mode the server latched feeds send_frame's `compress` arg.
         self.net_compress = _env_flag("WH_NET_COMPRESS")
         self._fc = [False] * self.world
+        # wire codec v2 (WH_WIRE / WH_WIRE_EF / WH_WIRE_COMP): the value
+        # encoding pushes carry and pulls request, whether error
+        # feedback is on (default yes — low-bit encodings without it
+        # bias convergence), and the negotiated frame compression mode.
+        # _wc[r] latches the server's `wire` capability ack: only an
+        # acked connection receives QuantRows encodings or quantized
+        # pull replies; an un-acked (older) peer keeps the legacy
+        # scalar fixed_bytes forms (see SyncedStore._quantize_deltas).
+        self.wire_enc = (os.environ.get("WH_WIRE") or "raw").strip().lower()
+        if self.wire_enc not in WIRE_ENCODINGS:
+            raise ValueError(f"WH_WIRE={self.wire_enc!r}: expected one "
+                             f"of {WIRE_ENCODINGS}")
+        ef = os.environ.get("WH_WIRE_EF")
+        self.wire_ef = (True if ef is None
+                        else ef.lower() not in ("", "0", "false", "off"))
+        self.wire_comp = (os.environ.get("WH_WIRE_COMP") or "").strip().lower()
+        if self.wire_comp not in WIRE_COMP_MODES:
+            raise ValueError(f"WH_WIRE_COMP={self.wire_comp!r}: expected "
+                             f"one of {WIRE_COMP_MODES}")
+        self._wc = [False] * self.world
         self._kc_idx = [collections.OrderedDict()
                         for _ in range(self.world)]
         self._kc_pushed = [collections.OrderedDict()
@@ -1164,25 +1273,50 @@ class PSClient:
         # cost of the feature is one attribute check
         self._hedge = _overload.hedge_tracker()
 
+    def _hello_offer(self) -> dict:
+        """Per-connection negotiation flags a hello should carry:
+        legacy zlib compression, the wire-codec capability ask, and the
+        requested frame-compression mode. Empty when every knob is at
+        its default (the hello is then skipped on the fast path)."""
+        offer: dict = {}
+        if self.net_compress:
+            offer["net_compress"] = 1
+        if self.wire_enc != "raw":
+            offer["wire"] = 1
+        if self.wire_comp:
+            offer["wire_comp"] = self.wire_comp
+        return offer
+
+    def _latch_hello(self, r: int, h: dict) -> None:  # wormlint: thread-owned
+        """Adopt a hello reply's negotiation acks for connection r: the
+        frame-compression mode (string modes win over legacy zlib) and
+        the wire-codec capability. An older server acks neither and the
+        connection degrades to raw framing + legacy scalar encodings."""
+        self._fc[r] = (h.get("wire_comp")
+                       if h.get("wire_comp") in ("zlib", "bshuf")
+                       else bool(h.get("net_compress")))
+        self._wc[r] = bool(h.get("wire"))
+
     def _file(self, r: int):  # wormlint: thread-owned
         if self._files[r] is None:
             host, port = self.uris[r].rsplit(":", 1)
             s = connect_with_retry((host, int(port)), self.connect_deadline)
             self._socks[r] = s
             self._files[r] = s.makefile("rwb")
-            if self.net_compress:
-                # negotiate frame compression before any payload frame:
-                # the server arms its side of the connection on this
-                # hello and the ack arms ours; an old/default server
-                # simply doesn't ack and the connection stays raw
+            offer = self._hello_offer()
+            if offer:
+                # negotiate before any payload frame: the server arms
+                # its side of the connection on this hello and the ack
+                # arms ours; an old/default server simply doesn't ack
+                # and the connection stays raw
                 f = self._files[r]
-                send_frame(f, {"op": "hello", "sender": self.sender,
-                               "net_compress": 1})
+                send_frame(f, dict({"op": "hello", "sender": self.sender},
+                                   **offer))
                 got = recv_frame(f)
                 if got is None:
                     raise ConnectionResetError(
-                        "connection closed during compression hello")
-                self._fc[r] = bool(got[0].get("net_compress"))
+                        "connection closed during negotiation hello")
+                self._latch_hello(r, got[0])
         return self._files[r]
 
     def _attempt(self, r: int, header: dict, arrays, fixed_bytes: int,
@@ -1207,14 +1341,16 @@ class PSClient:
         fresh ephemeral connection. Pulls are idempotent reads with no
         seq fence, so the duplicate is harmless by construction; the
         budget (WH_HEDGE_BUDGET_PCT) bounds the extra load. Gated off
-        for non-pull ops and under keycache/compression, whose
-        per-connection negotiated state a second connection would not
-        share. If the backup answers first it severs the pooled socket
+        for non-pull ops and under keycache/compression/wire-codec,
+        whose per-connection negotiated state a second connection would
+        not share (a hedged wire-codec pull would also advance the
+        server's pull-EF residuals twice for the same rows). If the backup answers first it severs the pooled socket
         so the primary's blocked recv turns into the error path, which
         hands back the backup's reply."""
         delay = (self._hedge.delay_s() if self._hedge is not None
                  and header.get("op") == "pull"
                  and not self.keycache and not self.net_compress
+                 and self.wire_enc == "raw" and not self.wire_comp
                  and not compress else None)
         if delay is None:
             return self._attempt(r, header, arrays, fixed_bytes, compress)
@@ -1414,11 +1550,10 @@ class PSClient:
                     deadline_s=min(2.0, max(budget.remaining, 0.1)))
                 self._socks[r] = s
                 self._files[r] = s.makefile("rwb")
-                hello: dict = {"op": "hello", "sender": self.sender}
-                if self.net_compress:
-                    hello["net_compress"] = 1
+                hello = dict({"op": "hello", "sender": self.sender},
+                             **self._hello_offer())
                 h, _, _, _ = self._attempt(r, hello, None, 0, False)
-                self._fc[r] = bool(h.get("net_compress"))
+                self._latch_hello(r, h)
                 self._note_epoch(r, h)
                 with self._stats_lock:  # shared tally; fan threads race
                     self.num_retries += 1
@@ -1492,11 +1627,10 @@ class PSClient:
                                        self.connect_deadline)
                 self._socks[r] = s
                 self._files[r] = s.makefile("rwb")
-                hello: dict = {"op": "hello", "sender": self.sender}
-                if self.net_compress:
-                    hello["net_compress"] = 1
+                hello = dict({"op": "hello", "sender": self.sender},
+                             **self._hello_offer())
                 h, _, _, _ = self._attempt(r, hello, None, 0, False)
-                self._fc[r] = bool(h.get("net_compress"))
+                self._latch_hello(r, h)
                 self._note_epoch(r, h)
                 _REHELLOS.inc()
                 applied = int(h.get("last_seq", 0))
@@ -1530,7 +1664,9 @@ class PSClient:
                 pass
             self._socks[i] = None
             self._files[i] = None
-            self._fc[i] = False  # compression is per-connection state
+            # compression + wire-codec acks are per-connection state
+            self._fc[i] = False
+            self._wc[i] = False
         if r is None and self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -1647,11 +1783,18 @@ class PSClient:
         }
 
     def pull_sparse(self, since: list[int], compress: bool = False,
+                    skip: Optional[list] = None,
                     ) -> tuple[list[int], dict[int, np.ndarray],
                                dict[str, np.ndarray]]:
         """Versioned pull: rows stamped after `since[r]` on each server.
         Returns (new clocks, {group_rows: global indices},
-        {table: rows aligned to its group's indices})."""
+        {table: rows aligned to its group's indices}).
+
+        `skip` names derived tables the caller will recompute from the
+        same reply's source rows (SyncedStore._fill_derived) — the
+        server omits their values from the wire. Purely advisory: a
+        server that predates the field ships them anyway and the caller
+        just uses the shipped rows."""
         kc = self.keycache and self.sender is not None
 
         def one(r: int) -> tuple[dict, dict]:  # wormlint: thread-entry thread-owned
@@ -1665,10 +1808,32 @@ class PSClient:
                 # state wholesale.
                 self._rolled_back[r] = False
                 s = 0
+            def wire_hdr(hdr: dict) -> dict:
+                # quantized pull replies (wire codec v2) ride only
+                # connections whose hello ack'd the capability; EF is
+                # keyed by sender, so anonymous clients get stateless
+                # quantization. Pulls cap at bf16 even when WH_WIRE is
+                # int8/int4: a pull is an ABSOLUTE-state refresh, and
+                # uniform absmax codes give errors relative to the
+                # hottest neighbor, not the element itself — on a
+                # skewed FTRL table that pushes small z past the L1
+                # threshold and diverges training. bf16's per-element
+                # relative precision is scale-free; int8/int4 stay on
+                # the EF-corrected DELTA streams (pushes), where the
+                # residual makes the error unbiased over rounds.
+                if self.wire_enc != "raw" and self._wc[r]:
+                    hdr["wire"] = ("bf16" if self.wire_enc in
+                                   ("int8", "int4") else self.wire_enc)
+                    if self.wire_ef and self.sender is not None:
+                        hdr.update(sender=self.sender, wire_ef=1)
+                return hdr
+
             header = {"op": "pull", "since": s}
+            if skip:
+                header["skip"] = list(skip)
             if kc:
                 header.update(sender=self.sender, kc=1)
-            h, arrs = self._rpc(r, header, compress=compress)
+            h, arrs = self._rpc(r, wire_hdr(header), compress=compress)
             if kc:
                 for gs, dig in (h.get("kfull") or {}).items():
                     # full reply stamped with its digest: cache the key
@@ -1686,7 +1851,10 @@ class PSClient:
                     # — correctness never depends on the cache
                     with self._stats_lock:
                         self.kc_misses += 1
-                    h, arrs = self._rpc(r, {"op": "pull", "since": s},
+                    hdr2 = {"op": "pull", "since": s}
+                    if skip:
+                        hdr2["skip"] = list(skip)
+                    h, arrs = self._rpc(r, wire_hdr(hdr2),
                                         compress=compress)
                 elif kdig:
                     for gs, dig in kdig.items():
@@ -1870,6 +2038,20 @@ class SyncedStore:
         self.touched_fn = touched_fn
         self._sparse_store = (hasattr(store, "gather_rows")
                               and hasattr(store, "scatter_rows"))
+        # wire codec v2 (client half): the encoding/EF/comp operating
+        # point lives on the PSClient (it owns the per-connection
+        # negotiation); this store quantizes each sync's delta rows
+        # once, with one EF accumulator per table, and tallies the
+        # f32-equivalent vs on-the-wire bytes for wire_stats
+        self.wire_enc = client.wire_enc
+        self.wire_ef = client.wire_ef
+        # per-table wire floor (TableSpec.wire_cap via the store hook):
+        # second-moment / count accumulator deltas never drop below bf16
+        cap_fn = getattr(store, "wire_cap_names", None)
+        self._wire_cap: set = set(cap_fn()) if cap_fn is not None else set()
+        self._efq: dict[str, EFQuant] = {}
+        self._wire_raw = 0
+        self._wire_bytes = 0
         self._base: dict[str, np.ndarray] = {}
         self._clocks: Optional[list[int]] = None
         self._steps = 0
@@ -1918,11 +2100,52 @@ class SyncedStore:
         self._clocks = [0] * self.client.world
         self._apply_pull()
 
+    def _pull_skip(self) -> Optional[list]:
+        """Derived tables to omit from quantized pull replies: w is a
+        pure function of (z, n), so shipping it alongside its sources
+        is a third bf16 table of pure redundancy — the client derives
+        the same rows from the same reply (_fill_derived). Raw-wire
+        pulls keep shipping it: there the contract is bit-identical
+        adoption of server state, and recomputing would trade exact
+        f32 equality for a formula re-evaluation."""
+        if self.wire_enc == "raw" or not self._wire_ok():
+            return None
+        sk = [k for k, s in self.derived.items()
+              if s.get("kind") == "ftrl_prox"]
+        return sk or None
+
+    def _fill_derived(self, groups: dict, tables: dict) -> dict:
+        """Client half of the derived-table wire skip: reconstruct any
+        derived table the reply omitted from its pulled source rows
+        (same ftrl_prox_rows the server runs, so both ends derive
+        identical values). A reply that still carries the table (older
+        server, raw wire) is used as-is."""
+        for k, spec in self.derived.items():
+            if spec.get("kind") != "ftrl_prox":
+                continue
+            z, n = tables.get("z"), tables.get("n")
+            if (z is None or n is None
+                    or self.client.full_rows.get("z")
+                    != self.client.full_rows.get(k)):
+                continue
+            if k in tables and tables[k].shape[0] == z.shape[0]:
+                # a complete part was shipped (raw wire, or every
+                # server predates the skip): adopt it as-is
+                continue
+            # absent — or PARTIAL: in a mixed world where only some
+            # servers honor the skip, the merged part covers only the
+            # non-honoring servers' rows and is useless; z/n are never
+            # skipped, so recomputing from them always aligns with the
+            # merged index
+            tables[k] = ftrl_prox_rows(spec, z, n)
+        return tables
+
     def _apply_pull(self) -> None:
         """Versioned pull: fetch rows dirty since our clocks, fold them
         into the base mirror and the device store."""
         clocks, groups, tables = self.client.pull_sparse(
-            self._clocks, compress=self.compress)
+            self._clocks, compress=self.compress, skip=self._pull_skip())
+        tables = self._fill_derived(groups, tables)
         for k, rows in tables.items():
             idx = groups[self.client.full_rows[k]]
             if idx.size == 0:
@@ -2031,6 +2254,65 @@ class SyncedStore:
                   for k in diffs}
         return groups, deltas
 
+    # -- wire codec v2 (push half) -------------------------------------------
+    def _wire_ok(self) -> bool:
+        """True when every server connection ack'd the wire codec in
+        its hello — QuantRows encodings only ship to peers that can
+        decode them (per-server slices come from ONE quantized array,
+        so the decision is all-or-nothing per sync)."""
+        return all(self.client._wc)
+
+    def _wire_fb(self) -> int:
+        """Effective fixed_bytes for this sync's push: when WH_WIRE is
+        set but a server didn't ack the codec (older peer), degrade to
+        the legacy bf16 truncation form (fixed_bytes=2) for EVERY
+        quantized encoding instead of sending frames the peer can't
+        decode. Not fixed_bytes=1: that form is one global absmax scale
+        over the whole push — exactly the hot-neighbor granularity
+        pathology wire_cap exists to avoid, with no EF and no per-table
+        escape hatch."""
+        if self.wire_enc == "raw" or self._wire_ok():
+            return self.fixed_bytes
+        return 2
+
+    def _quantize_deltas(self, groups: dict, deltas: dict) -> dict:
+        """Quantize a sync round's delta rows ONCE into QuantRows
+        (per-row scales for 2-D tables, grouped scales for 1-D), folding in and
+        advancing the per-table error-feedback residuals. Everything
+        downstream — the per-server searchsorted split, the push
+        journal, a need_keys full resend — slices/replays these same
+        objects, so every (re)send of a logical sync serializes to
+        identical bytes and a residual can never be applied twice.
+        Returns the deltas untouched when the codec is off or a peer
+        didn't negotiate it (see _wire_fb's legacy fallback)."""
+        if self.wire_enc == "raw" or not self._wire_ok():
+            return deltas
+        out: dict = {}
+        for k, d in deltas.items():
+            idx = groups[self.client.full_rows[k]]
+            if not idx.size:
+                out[k] = d
+                continue
+            # wire_cap floor: accumulator tables (FTRL n, difacto
+            # n/cnt/nV) ship at bf16 even under int8/int4 — an absmax
+            # group code quantizes a cold bucket's delta at the hot
+            # neighbor's granularity, mis-scaling its learning rate in
+            # a way EF can't repair (see TableSpec.wire_cap)
+            enc = ("bf16" if k in self._wire_cap
+                   and self.wire_enc in ("int8", "int4")
+                   else self.wire_enc)
+            if self.wire_ef:
+                efq = self._efq.get(k)
+                if efq is None:
+                    efq = self._efq[k] = EFQuant(enc)
+                qr = efq.apply(idx, d)
+            else:
+                qr = quantize_rows(d, enc)
+            out[k] = qr
+            self._wire_raw += 4 * int(qr.q.size)
+            self._wire_bytes += qr.wire_nbytes()
+        return out
+
     # -- async comms plane ---------------------------------------------------
     def _ensure_comm_thread(self) -> None:
         if self._comm_thread is None:
@@ -2059,12 +2341,13 @@ class SyncedStore:
                     with _trace.span("ps.sync.push", cat="ps"):
                         self.client.push_sparse(
                             job["groups"], job["deltas"],
-                            fixed_bytes=self.fixed_bytes,
+                            fixed_bytes=self._wire_fb(),
                             compress=self.compress)
                     t1 = time.perf_counter()
                     with _trace.span("ps.sync.pull", cat="ps"):
                         job["pull"] = self.client.pull_sparse(
-                            self._clocks, compress=self.compress)
+                            self._clocks, compress=self.compress,
+                            skip=self._pull_skip())
                 t2 = time.perf_counter()
                 _SYNC_PUSH_S.observe(t1 - t0)
                 _SYNC_PULL_S.observe(t2 - t1)
@@ -2113,7 +2396,7 @@ class SyncedStore:
         self.max_fold_lag = max(self.max_fold_lag,
                                 self.num_syncs - job["enq_sync"])
         clocks, groups, tables = job["pull"]
-        self._fold_rows(groups, tables)
+        self._fold_rows(groups, self._fill_derived(groups, tables))
         self._clocks = clocks
 
     def _fold_rows(self, groups: dict, tables: dict) -> None:
@@ -2176,11 +2459,19 @@ class SyncedStore:
                 got = self._scan_groups()
             groups, deltas = got
             # mark the snapshot as pushed NOW: the next delta starts
-            # from zero and the fold can tell un-pushed progress apart
+            # from zero and the fold can tell un-pushed progress apart.
+            # Base advances by the RAW delta even under quantization:
+            # the quantization error lives in the EF residuals (not the
+            # mirror), so the fold algebra below stays unchanged and
+            # the error re-ships with the next sync that touches the
+            # row.
             for k, d in deltas.items():
                 idx = groups[self.client.full_rows[k]]
                 if idx.size:
                     self._base[k][idx] += d
+            # quantize on the TRAIN thread (EF state is single-writer
+            # here; the comms thread only serializes the result)
+            deltas = self._quantize_deltas(groups, deltas)
         self._ensure_comm_thread()
         job = {"groups": groups, "deltas": deltas,
                "done": threading.Event(), "enq_sync": self.num_syncs}
@@ -2202,8 +2493,10 @@ class SyncedStore:
                 if got is None:
                     got = self._scan_groups()
                 groups, deltas = got
-                self.client.push_sparse(groups, deltas,
-                                        fixed_bytes=self.fixed_bytes,
+                self.client.push_sparse(groups,
+                                        self._quantize_deltas(groups,
+                                                              deltas),
+                                        fixed_bytes=self._wire_fb(),
                                         compress=self.compress)
             t1 = time.perf_counter()
             with _trace.span("ps.sync.pull", cat="ps"):
@@ -2282,11 +2575,19 @@ class SyncedStore:
         kc_total = c.kc_hits + c.kc_misses
         overlap = (max(0.0, 1.0 - self._wait_wall / self._rt_wall)
                    if self._rt_wall > 0 else 0.0)
+        resid = (sum(e.resid_norm() ** 2 for e in self._efq.values())
+                 ** 0.5 if self._efq else 0.0)
         return {"plane": "tcp",
                 "num_syncs": self.num_syncs,
                 "bytes_push": c.bytes_push,
                 "bytes_pull": c.bytes_pull,
                 "bytes_per_sync": (c.bytes_push + c.bytes_pull) / n,
+                "wire_codec": self.wire_enc,
+                "wire_ef": int(self.wire_ef and self.wire_enc != "raw"),
+                "wire_comp": c.wire_comp,
+                "wire_bytes_raw": self._wire_raw,
+                "wire_bytes_wire": self._wire_bytes,
+                "wire_ef_resid_norm": round(resid, 6),
                 "async_sync": int(self.async_sync),
                 "sync_overlap_frac": round(overlap, 4),
                 "push_ms_per_sync": round(1e3 * self._push_s / n, 3),

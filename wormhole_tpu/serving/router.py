@@ -324,6 +324,21 @@ class Router:
         # per-request python loop of searchsorted pairs collapses to
         # one cached boundary array + one vectorized searchsorted
         self._split_edges: Dict[int, np.ndarray] = {}
+        # opt-in reply quantization (WH_SERVE_WIRE): stamped on every
+        # fetch/score request header; a stamped shard bf16-truncates
+        # its reply floats at send time, halving reply bytes under the
+        # documented ulp contract (docs/serving.md). Default raw keeps
+        # serving bit-identical to the trainer's own predict. An old
+        # shard ignores the stamp and replies raw — the decode path is
+        # per-array self-describing, so mixed groups still work.
+        # Validated BEFORE dialing so a bad knob fails fast.
+        sw = str(knob_value("WH_SERVE_WIRE") or "").strip().lower()
+        if sw in ("", "raw", "off", "0"):
+            sw = ""
+        elif sw != "bf16":
+            raise ValueError(
+                f"unknown WH_SERVE_WIRE {sw!r} (expected 'raw' or 'bf16')")
+        self.serve_wire = sw
         hello = self._rpc(0, {"op": "hello"}, {})[0]
         if int(hello["world"]) != self.world:
             raise RuntimeError(
@@ -600,9 +615,12 @@ class Router:
             jobs.append((r, present, arrays))
         ctx = _trace.current_ctx()
         dl = _overload.current()
+        base = {"op": "fetch"}
+        if self.serve_wire:
+            base["wire"] = self.serve_wire
         futs = [self._pool.submit(
             self._rpc_traced, ctx, dl, r,
-            {"op": "fetch", "tables": present}, arrays)
+            dict(base, tables=present), arrays)
             for r, present, arrays in jobs]
         got = [f.result() for f in futs]
         versions = {int(reply["version"]) for reply, _ in got}
@@ -769,6 +787,8 @@ class Router:
         starts = np.concatenate(([0], np.cumsum(counts)))
         hdr = {"op": "score", "kind": self.scorer.score_kind,
                "rows": pack.rows, **self.scorer.score_header()}
+        if self.serve_wire:
+            hdr["wire"] = self.serve_wire
         difacto = self.scorer.score_kind == "difacto"
         jobs = []  # (rank, payload arrays)
         for r in range(self.world):

@@ -24,6 +24,13 @@ Retries are exactly-once in the reply sense: fetches are seq-stamped
 per sender and the last reply per sender is cached, so a retried frame
 (after a busy bounce or a socket error) returns the ORIGINAL reply —
 same rows, same version — instead of re-reading possibly newer state.
+
+Wire codec: a fetch/score request stamped ``wire=bf16`` (router knob
+WH_SERVE_WIRE) has its reply floats bf16-truncated at send time —
+half the reply bytes under the ulp contract of docs/distributed.md.
+The reply cache stores raw arrays and the truncation is deterministic,
+so duplicates stay bit-identical on the wire; the default (no stamp)
+keeps serving byte-for-byte identical to the trainer's own predict.
 """
 
 from __future__ import annotations
@@ -221,7 +228,17 @@ class _ServeHandler(socketserver.StreamRequestHandler):
                         header, arrays, t_in)
             finally:
                 srv._gate.leave(op, time.perf_counter() - t_in)
-            send_frame(self.wfile, resp_header, resp_arrays)
+            # opt-in serving wire codec (WH_SERVE_WIRE on the router):
+            # a fetch/score request stamped wire=bf16 gets its reply
+            # floats bf16-truncated AT SEND TIME. The reply cache keeps
+            # RAW arrays, so a retried or hedged duplicate re-encodes
+            # to the exact same bytes (RNE truncation is deterministic)
+            # — exactly-once still means bit-identical duplicates.
+            fb = (2 if (header.get("wire") == "bf16"
+                        and op in ("fetch", "score")
+                        and "error" not in resp_header) else 0)
+            send_frame(self.wfile, resp_header, resp_arrays,
+                       fixed_bytes=fb)
             if header.get("op") == "shutdown":
                 srv._shutdown.set()
                 return
