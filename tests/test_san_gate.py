@@ -1,0 +1,43 @@
+"""CI sanitizer gate: the threaded unit tests must run WH_SAN-clean.
+
+The dynamic twin of tests/test_lint_gate.py: re-runs a representative
+threaded slice of the suite in a subprocess with the runtime sanitizer
+armed (WH_SAN=1) and asserts zero findings land in the dump dir — no
+new lock-order inversions, no blocking calls under registry-known
+locks, no candidate lockset races.  Anything benign-by-design must be
+annotated ``# wormsan: allow=<detector>`` at the site, the same
+contract as the static baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: thread-heavy modules: obs contention tests, the overload controllers,
+#: and the serving shard/router stack all exercise real lock traffic
+GATE_TESTS = ("tests/test_obs.py", "tests/test_overload.py",
+              "tests/test_serving.py")
+
+
+def test_threaded_suite_is_san_clean(tmp_path):
+    dump = tmp_path / "san"
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "WH_SAN": "1", "WH_SAN_DUMP_DIR": str(dump)})
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", *GATE_TESTS, "-q", "-m",
+         "not slow", "-p", "no:cacheprovider", "-p", "no:randomly"],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, \
+        f"threaded tests failed under WH_SAN=1:\n{proc.stdout[-4000:]}" \
+        f"\n{proc.stderr[-2000:]}"
+    findings = []
+    if dump.is_dir():
+        for path in sorted(dump.glob("san-*.jsonl")):
+            findings += [json.loads(x) for x in
+                         path.read_text().splitlines() if x.strip()]
+    assert findings == [], "\n".join(
+        f"[{f['detector']}] {f['message']}" for f in findings)

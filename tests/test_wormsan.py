@@ -1,0 +1,339 @@
+"""wormsan runtime-sanitizer tests.
+
+Every test that arms the sanitizer runs it in a *subprocess*: install()
+monkeypatches threading/socket/queue/os process-wide and on purpose has
+no uninstall, so an in-process install would leak instrumentation into
+the rest of the pytest run (the tier-1 suite must see the default,
+unpatched process).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_py(code: str, env_extra: dict | None = None,
+            timeout: float = 120.0) -> subprocess.CompletedProcess:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("WH_SAN", "WH_SAN_DUMP_DIR", "WH_SAN_SAMPLE")}
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, "-c", textwrap.dedent(code)],
+                          cwd=REPO, capture_output=True, text=True,
+                          env=env, timeout=timeout)
+
+
+# --- seeded fixtures: the selftest is the contract --------------------------
+
+def test_selftest_detects_all_three_fixture_classes():
+    r = _run_py("import tools.wormsan.__main__ as m; import sys; "
+                "sys.exit(m.main(['--selftest']))")
+    assert r.returncode == 0, r.stdout + r.stderr
+    for det in ("order", "block", "race"):
+        assert f"selftest[{det}]: PASS" in r.stdout, r.stdout
+
+
+def test_lock_order_finding_carries_both_acquisition_stacks():
+    r = _run_py("""
+        import json
+        from tools import wormsan
+        from tools.wormsan import fixtures
+        wormsan.install(instrument=False)
+        fixtures.lock_order_cycle()
+        fs = [f for f in wormsan.findings() if f["detector"] == "order"]
+        print(json.dumps(fs))
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    fs = json.loads(r.stdout.strip().splitlines()[-1])
+    assert len(fs) == 1
+    f = fs[0]
+    assert "fixtures.py" in f["message"]
+    # one captured stack per edge of the cycle, each pointing at the
+    # fixture's acquisition lines
+    assert len(f["stacks"]) >= 2
+    assert all("lock_order_cycle" in s for s in f["stacks"].values())
+
+
+def test_blocking_send_finding_names_the_known_lock():
+    r = _run_py("""
+        import json
+        from tools import wormsan
+        from tools.wormsan import fixtures
+        wormsan.install(instrument=False)
+        fixtures.blocking_send_under_lock()
+        fs = [f for f in wormsan.findings() if f["detector"] == "block"]
+        print(json.dumps(fs))
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    fs = json.loads(r.stdout.strip().splitlines()[-1])
+    assert len(fs) == 1
+    assert "_Sender._lock" in fs[0]["message"]
+    assert "socket.sendall" in fs[0]["message"]
+    assert "blocking_send_under_lock" in fs[0]["stacks"]["call"]
+
+
+def test_race_finding_has_transition_and_write_stacks():
+    r = _run_py("""
+        import json
+        from tools import wormsan
+        from tools.wormsan import fixtures
+        wormsan.install(instrument=False)
+        fixtures.unguarded_shared_write()
+        fs = [f for f in wormsan.findings() if f["detector"] == "race"]
+        print(json.dumps(fs))
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    fs = json.loads(r.stdout.strip().splitlines()[-1])
+    assert len(fs) == 1
+    f = fs[0]
+    assert f["key"] == "race:_Shared.hits"
+    assert "writer" in f["stacks"]["transition"]
+    assert "writer" in f["stacks"]["write"]
+
+
+# --- default-off and arming behavior ----------------------------------------
+
+def test_off_by_default_nothing_is_patched():
+    r = _run_py("""
+        import threading, sys
+        import wormhole_tpu
+        assert threading.Lock is not None
+        assert type(threading.Lock()).__module__ == '_thread', \\
+            type(threading.Lock())
+        assert not any(m.startswith('tools.wormsan') for m in sys.modules), \\
+            [m for m in sys.modules if m.startswith('tools.wormsan')]
+        print('unpatched')
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "unpatched" in r.stdout
+
+
+def test_wh_san_arms_at_package_import_and_instruments_model():
+    r = _run_py("""
+        import threading
+        import wormhole_tpu
+        from tools import wormsan
+        assert wormsan.enabled()
+        assert threading.Lock is wormsan.SanLock
+        assert threading.RLock is wormsan.SanRLock
+        # the shared-state model classes got a patched __setattr__
+        from wormhole_tpu.obs.metrics import Counter
+        assert Counter.__setattr__.__name__ == '_san_setattr'
+        print('armed')
+    """, env_extra={"WH_SAN": "1"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "armed" in r.stdout
+
+
+def test_clean_threaded_workload_produces_no_findings():
+    r = _run_py("""
+        import threading
+        from tools import wormsan
+        wormsan.install(instrument=False)
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+        wormsan.watch_class(Guarded, attrs=("n",), locks=("_lock",))
+        g = Guarded()
+
+        def work():
+            for _ in range(200):
+                with g._lock:
+                    g.n += 1
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert g.n == 800
+        assert wormsan.findings() == [], wormsan.findings()
+        print('clean')
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_wormsan_allow_comment_suppresses_at_runtime(tmp_path):
+    mod = tmp_path / "allowmod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        class Shared:
+            def __init__(self):
+                self.x = 0
+
+        def hammer(obj):
+            obj.x += 1  # wormsan: allow=race
+    """))
+    r = _run_py(f"""
+        import sys, threading
+        sys.path.insert(0, {str(tmp_path)!r})
+        from tools import wormsan
+        wormsan.install(instrument=False)
+        import allowmod
+        wormsan.watch_class(allowmod.Shared, attrs=("x",))
+        obj = allowmod.Shared()
+        allowmod.hammer(obj)
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (allowmod.hammer(obj),
+                                             done.set()))
+        t.start(); t.join()
+        assert done.is_set()
+        allowmod.hammer(obj)
+        assert wormsan.findings() == [], wormsan.findings()
+        print('suppressed')
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "suppressed" in r.stdout
+
+
+def test_sampling_skips_most_race_checks():
+    r = _run_py("""
+        import threading
+        from tools import wormsan
+
+        class Shared:
+            def __init__(self):
+                self.x = 0
+        wormsan.install(instrument=False)
+        wormsan.watch_class(Shared, attrs=("x",))
+        obj = Shared()
+        obj.x = 1
+        t = threading.Thread(target=lambda: setattr(obj, 'x', 2))
+        t.start(); t.join()
+        assert wormsan.findings() == [], wormsan.findings()
+        print('sampled-out')
+    """, env_extra={"WH_SAN_SAMPLE": "1000000"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sampled-out" in r.stdout
+
+
+# --- reporting plumbing ------------------------------------------------------
+
+def test_findings_dump_to_jsonl_and_replay_cli(tmp_path):
+    dump = tmp_path / "san"
+    r = _run_py("""
+        from tools import wormsan
+        from tools.wormsan import fixtures
+        wormsan.install(instrument=False)
+        fixtures.lock_order_cycle()
+    """, env_extra={"WH_SAN_DUMP_DIR": str(dump)})
+    assert r.returncode == 0, r.stdout + r.stderr
+    files = list(dump.glob("san-*.jsonl"))
+    assert len(files) == 1
+    recs = [json.loads(x) for x in
+            files[0].read_text().strip().splitlines()]
+    assert recs and recs[0]["detector"] == "order"
+
+    replay = subprocess.run(
+        [sys.executable, "-m", "tools.wormsan", "--stacks", str(dump)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert replay.returncode == 1  # findings exist -> nonzero verdict
+    assert "order" in replay.stdout
+    assert "lock_order_cycle" in replay.stdout  # stacks printed
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    replay0 = subprocess.run(
+        [sys.executable, "-m", "tools.wormsan", str(empty)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert replay0.returncode == 0
+
+
+def test_findings_feed_san_counters():
+    r = _run_py("""
+        from tools import wormsan
+        from tools.wormsan import fixtures
+        wormsan.install(instrument=False)
+        fixtures.lock_order_cycle()
+        from wormhole_tpu.obs.metrics import REGISTRY
+        wormsan.summary()  # drains any deferred counter bumps
+        c = REGISTRY.snapshot()["counters"]
+        assert c.get("san.findings", 0) >= 1, c
+        assert c.get("san.order.cycles", 0) >= 1, c
+        print('counted')
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "counted" in r.stdout
+
+
+def test_shared_model_is_wormlints():
+    """Static and dynamic share one model: the classes wormsan loads are
+    exactly what shared_state_model infers over wormhole_tpu/."""
+    from tools.wormlint.core import load_files
+    from tools.wormlint.locks import shared_state_model
+    from tools.wormsan import load_model
+
+    here = os.getcwd()
+    os.chdir(REPO)
+    try:
+        expect = shared_state_model(load_files(["wormhole_tpu"]))
+    finally:
+        os.chdir(here)
+    got = load_model()
+    assert got == expect
+    # sanity: the model is non-trivial and covers known hot classes
+    assert "wormhole_tpu/obs/metrics.py" in got
+    assert "wormhole_tpu/runtime/tracker.py" in got
+
+
+def test_overhead_smoke():
+    """Armed lock traffic must stay within an order-of-magnitude-ish
+    budget — a regression to pathological overhead (or a deadlock)
+    fails/hangs this quickly."""
+    code = """
+        import threading, time
+        %s
+        lk = threading.Lock()
+        t0 = time.perf_counter()
+        for _ in range(20000):
+            with lk:
+                pass
+        print(time.perf_counter() - t0)
+    """
+    base = _run_py(code % "")
+    armed = _run_py(code % (
+        "from tools import wormsan; wormsan.install(instrument=False)"))
+    assert base.returncode == 0 and armed.returncode == 0, \
+        base.stderr + armed.stderr
+    t_base = float(base.stdout.strip().splitlines()[-1])
+    t_armed = float(armed.stdout.strip().splitlines()[-1])
+    # generous: CI boxes are noisy; catching 100x blowups is the point
+    assert t_armed < max(t_base * 60.0, 2.0), (t_base, t_armed)
+
+
+def test_rlock_and_condition_survive_instrumentation():
+    r = _run_py("""
+        import threading
+        from tools import wormsan
+        wormsan.install(instrument=False)
+        rl = threading.RLock()
+        with rl:
+            with rl:
+                pass
+        cond = threading.Condition()
+        results = []
+
+        def waiter():
+            with cond:
+                while not results:
+                    cond.wait(5.0)
+                results.append('woke')
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time; time.sleep(0.05)
+        with cond:
+            results.append('go')
+            cond.notify()
+        t.join(5.0)
+        assert results == ['go', 'woke'], results
+        assert wormsan.findings() == [], wormsan.findings()
+        print('cond-ok')
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "cond-ok" in r.stdout

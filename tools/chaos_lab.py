@@ -1376,6 +1376,15 @@ def main(argv=None) -> int:
                          "on (WH_PROF=1, obs/pyprof.py): each process "
                          "writes prof-*.folded into its obs dir and the "
                          "matrix prints the heaviest stacks per scenario")
+    ap.add_argument("--san", action="store_true",
+                    help="run every scenario with the concurrency "
+                         "sanitizer armed (WH_SAN=1, tools/wormsan): "
+                         "each process dumps findings as JSONL into a "
+                         "shared dir, and ANY finding across the matrix "
+                         "fails the verdict — recovery churn (respawns, "
+                         "reconnects, partition heal) is exactly when "
+                         "lock-order inversions and lockset races "
+                         "surface")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir (data + confs)")
     args = ap.parse_args(argv)
@@ -1385,6 +1394,45 @@ def main(argv=None) -> int:
         # all four matrices inherit the profiler arm from here
         os.environ["WH_PROF"] = "1"
 
+    san_dir = None
+    if args.san:
+        # same inheritance path as --prof: run_job/run_bsp_job copy
+        # os.environ and the launcher's pass_env forwards WH_SAN* to
+        # every worker/server/scheduler it spawns
+        san_dir = tempfile.mkdtemp(prefix="wh_chaos_san_")
+        os.environ["WH_SAN"] = "1"
+        os.environ["WH_SAN_DUMP_DIR"] = san_dir
+        print(f"[chaos] sanitizer armed: WH_SAN=1 dump={san_dir}")
+    rc = _dispatch(args)
+    if san_dir is not None:
+        rc = _san_verdict(san_dir, rc, keep=args.keep)
+    return rc
+
+
+def _san_verdict(san_dir: str, rc: int, keep: bool = False) -> int:
+    """Fold sanitizer findings into the matrix verdict: any finding
+    from any process of any scenario fails the run (annotate benign
+    sites with ``# wormsan: allow=<detector>`` instead)."""
+    from tools.wormsan.__main__ import load_dump_dir
+
+    findings = load_dump_dir(san_dir)
+    if not findings:
+        print("[chaos] san: clean (0 findings)")
+        if not keep:
+            import shutil
+
+            shutil.rmtree(san_dir, ignore_errors=True)
+        return rc
+    print(f"[chaos] san: {len(findings)} finding(s) "
+          f"(dump kept: {san_dir}):")
+    for f in findings:
+        print(f"[chaos]   [{f['detector']}] {f['message']}")
+    print(f"[chaos] replay with: {sys.executable} -m tools.wormsan "
+          f"--stacks {san_dir}")
+    return max(rc, 1)
+
+
+def _dispatch(args) -> int:
     if args.codec:
         args.workers = args.workers or 2
         return codec_matrix(args)

@@ -487,6 +487,17 @@ def main(argv=None) -> int:
     try:
         return _main(args)
     finally:
+        if os.environ.get("WH_SAN") == "1":
+            # the lab is one process of threads — exactly the workload
+            # the sanitizer watches; arm with WH_SAN=1 before launch
+            from tools import wormsan
+
+            print("[serve-lab] san: "
+                  + json.dumps(wormsan.summary(), sort_keys=True),
+                  flush=True)
+            for f in wormsan.findings():
+                print(f"[serve-lab] san [{f['detector']}] "
+                      f"{f['message']}", flush=True)
         if prof is not None:
             print(f"[serve-lab] prof: overhead "
                   f"{prof.overhead_frac() * 100:.2f}% "

@@ -1,8 +1,8 @@
 """wormlint: AST static analysis for wormhole-tpu's bug classes.
 
-Six checkers over ``wormhole_tpu/``, ``tools/`` and ``bench.py``:
+Eight checkers over ``wormhole_tpu/``, ``tools/`` and ``bench.py``:
 lock-discipline, env-knobs, metric-names, jit-purity, thread-lifecycle,
-retry-policy.
+retry-policy, rpc-discipline, frame-header.
 See docs/static_analysis.md and ``python -m tools.wormlint --help``.
 """
 
@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-from . import jitpure, knobs, locks, metricnames, retrypolicy, threads
+from . import (frameheader, jitpure, knobs, locks, metricnames,
+               retrypolicy, rpcdiscipline, threads)
 from .core import (CHECKERS, FileSource, Finding, apply_suppressions,
                    load_baseline, load_files, match_baseline, save_baseline)
 
@@ -40,6 +41,10 @@ def run_checks(files: list[FileSource],
         findings.extend(threads.check(files))
     if want(retrypolicy.CHECKER):
         findings.extend(retrypolicy.check(files))
+    if want(rpcdiscipline.CHECKER):
+        findings.extend(rpcdiscipline.check(files))
+    if want(frameheader.CHECKER):
+        findings.extend(frameheader.check(files))
     findings = apply_suppressions(files, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.checker, f.key))
     return findings

@@ -27,7 +27,8 @@ import re
 from typing import Any, Callable, Iterable, Optional
 
 CHECKERS = ("lock-discipline", "env-knobs", "metric-names", "jit-purity",
-            "thread-lifecycle", "retry-policy")
+            "thread-lifecycle", "retry-policy", "rpc-discipline",
+            "frame-header")
 
 _DIRECTIVE_RE = re.compile(r"#\s*wormlint:\s*(.+?)\s*$")
 _GUARDED_BY_RE = re.compile(r"guarded-by\(([^)]+)\)")

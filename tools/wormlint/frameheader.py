@@ -1,0 +1,208 @@
+"""frame-header: cross-check wire-header keys against runtime/net.py.
+
+The frame protocol (4-byte length | JSON header | payload) and the
+scheduler's newline-JSON RPC both carry typed fields in their header
+dicts — `dl`, `inc`, `tctx`, `wire`, ... — and nothing but convention
+kept senders and receivers agreeing on the vocabulary. `HEADER_KEYS`
+in ``wormhole_tpu/runtime/net.py`` is now the central declaration
+table (a dict literal mapping key -> doc line, parsed statically like
+the metric-name registry; the module is never imported).
+
+Scope: a file participates in the frame plane if its text mentions
+``send_frame``/``recv_frame`` (and in the scheduler plane if it
+mentions ``_JOURNALED_OPS``). Within those files the checker tracks
+
+* reads/writes through header-named variables (``header``, ``hdr``,
+  ``resp_header``, ``h``, ``hello``, ... — plus ``req``/``resp`` in
+  the scheduler plane): ``hv["k"]``, ``hv.get("k")``,
+  ``hv.setdefault("k", ...)``, ``hv["k"] = ...``;
+* header dict construction: a dict literal or ``dict(...)`` call
+  assigned to a header-named variable, passed to ``send_frame`` /
+  ``*_rpc*`` calls, or wrapping another header expression
+  (``dict(shed_reply(header), inc=...)``).
+
+Per-array metadata (the entries of the ``arrays`` list: ``name``,
+``shape``, ``enc``, ...) is owned by net.py's codec and not tracked
+here — only top-level header keys are.
+
+Findings: a key used anywhere but not declared in HEADER_KEYS
+(``undeclared:<key>``), a declared key whose string literal appears
+nowhere else in the scanned tree (``unused:<key>`` — the raw-text
+test keeps renames honest without chasing every alias a reply dict
+travels under), and a missing registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import FileSource, Finding, terminal_name
+
+CHECKER = "frame-header"
+
+REGISTRY_PATH_SUFFIX = "runtime/net.py"
+REGISTRY_NAME = "HEADER_KEYS"
+
+#: variable names treated as frame headers in frame-plane files
+_HEADER_VARS = frozenset({
+    "header", "hdr", "hdr2", "resp_header", "req_header", "reply_header",
+    "h", "rh", "hello", "shed_hdr", "busy_hdr",
+})
+#: additional header names in the scheduler (newline-JSON) plane
+_SCHED_VARS = frozenset({"req", "resp"})
+
+#: calls whose dict-valued arguments are request/reply headers
+_HEADER_CALLS = frozenset({
+    "send_frame", "_rpc", "_rpc_traced", "rpc", "busy_reply", "shed_reply",
+})
+
+
+def parse_registry(src: FileSource,
+                   ) -> Optional[tuple[dict[str, int], tuple[int, int]]]:
+    """(key -> declaration line, literal line span) from HEADER_KEYS."""
+    for node in src.tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target]
+        value = node.value
+        if value is None or not isinstance(value, ast.Dict):
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == REGISTRY_NAME:
+                out: dict[str, int] = {}
+                for k in value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        out[k.value] = k.lineno
+                return out, (node.lineno, node.end_lineno or node.lineno)
+    return None
+
+
+def _dict_keys(node: ast.AST) -> Iterable[str]:
+    """String keys of a dict literal or dict(...) call (keywords and a
+    nested literal/dict() first argument)."""
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                yield k.value
+    elif isinstance(node, ast.Call) and terminal_name(node.func) == "dict":
+        for kw in node.keywords:
+            if kw.arg is not None:
+                yield kw.arg
+        if node.args:
+            yield from _dict_keys(node.args[0])
+
+
+def _is_header_expr(node: ast.AST, names: frozenset[str]) -> bool:
+    t = terminal_name(node)
+    if t in names or t in _HEADER_CALLS:
+        return True
+    if isinstance(node, ast.Call):
+        return _is_header_expr(node.func, names)
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, names: frozenset[str]):
+        self.names = names
+        self.uses: list[tuple[str, int]] = []  # (key, line)
+
+    def _use_dict(self, node: ast.AST) -> None:
+        for key in _dict_keys(node):
+            self.uses.append((key, node.lineno))
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        t = terminal_name(node.value)
+        if t in self.names and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            self.uses.append((node.slice.value, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("get", "setdefault", "pop") and \
+                    terminal_name(f.value) in self.names and node.args:
+                key = node.args[0]
+                if isinstance(key, ast.Constant) and \
+                        isinstance(key.value, str):
+                    self.uses.append((key.value, node.lineno))
+        fname = terminal_name(f)
+        if fname in _HEADER_CALLS:
+            # the header rides in argument position 1 (send_frame(f,
+            # hdr, arrays) / _rpc(rank, hdr, arrays)); later dicts are
+            # array payloads whose keys are array names, not headers
+            if len(node.args) > 1:
+                self._use_dict(node.args[1])
+        elif fname == "dict" and (node.args and
+                                  _is_header_expr(node.args[0], self.names)):
+            # dict(header, k=..., ...): augmenting an existing header
+            self._use_dict(node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id in self.names:
+                self._use_dict(node.value)
+        self.generic_visit(node)
+
+
+def check(files: list[FileSource],
+          registry_path_suffix: str = REGISTRY_PATH_SUFFIX) -> list[Finding]:
+    reg_src = None
+    for src in files:
+        if src.path.replace("\\", "/").endswith(registry_path_suffix):
+            reg_src = src
+            break
+    findings: list[Finding] = []
+    if reg_src is None:
+        if files:
+            findings.append(Finding(
+                CHECKER, files[0].path, 1, key="missing-registry",
+                message=(f"no frame-header registry "
+                         f"({registry_path_suffix}) in the scanned tree")))
+        return findings
+    parsed = parse_registry(reg_src)
+    if parsed is None:
+        findings.append(Finding(
+            CHECKER, reg_src.path, 1, key="missing-registry",
+            message=(f"{reg_src.path} has no {REGISTRY_NAME} dict literal "
+                     f"declaring the frame-header keys")))
+        return findings
+    declared, (reg_lo, reg_hi) = parsed
+
+    for src in files:
+        frame_plane = "send_frame" in src.text or "recv_frame" in src.text
+        sched_plane = "_JOURNALED_OPS" in src.text
+        if not frame_plane and not sched_plane:
+            continue
+        names = _HEADER_VARS | (_SCHED_VARS if sched_plane else frozenset())
+        v = _Visitor(frozenset(names))
+        v.visit(src.tree)
+        seen: set[str] = set()
+        for key, line in v.uses:
+            if key in declared or key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                CHECKER, src.path, line, key=f"undeclared:{key}",
+                message=(f"header key `{key}` is read/written here but not "
+                         f"declared in {REGISTRY_NAME} "
+                         f"({registry_path_suffix}) — typo, or declare it")))
+
+    # use-scan corpus: every other file, plus the registry file with the
+    # HEADER_KEYS literal itself blanked (a declaration is not a use)
+    reg_rest = "\n".join(line for i, line in enumerate(reg_src.lines, 1)
+                         if not reg_lo <= i <= reg_hi)
+    corpus = "\n".join(s.text for s in files if s is not reg_src) \
+        + "\n" + reg_rest
+    for key, line in sorted(declared.items()):
+        if f'"{key}"' in corpus or f"'{key}'" in corpus:
+            continue
+        findings.append(Finding(
+            CHECKER, reg_src.path, line, key=f"unused:{key}",
+            message=(f"declared header key `{key}` appears nowhere else in "
+                     f"the scanned tree — stale declaration?")))
+    return findings

@@ -341,6 +341,46 @@ def _guarded(guards: list[ast.AST], lock_attrs: set[str]) -> bool:
     return any(_lockish_expr(g, lock_attrs) for g in guards)
 
 
+def shared_state_model(files: list[FileSource],
+                       ) -> dict[str, dict[str, dict[str, list[str]]]]:
+    """The static shared-state model the runtime sanitizer reuses.
+
+    ``{path: {class name: {"attrs": [...], "locks": [...]}}}`` for every
+    class with thread-entry functions: ``attrs`` is the set of instance
+    attributes mutated from foreign context (lock, synced, and
+    thread-owned attributes excluded — the candidate set whose writes
+    ``tools.wormsan`` instruments with the Eraser lockset check) and
+    ``locks`` the class's inferred lock attributes. Sharing one model
+    keeps the static and dynamic passes flagging the same state.
+    """
+    model: dict[str, dict[str, dict[str, list[str]]]] = {}
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _collect_class(src, node)
+            _mark_entries(src, info)
+            if not info.entry_funcs:
+                continue
+            muts: list[_Mutation] = []
+            for method in info.methods.values():
+                v = _SiteVisitor(src, info, method, set(info.entry_funcs))
+                v.visit(method)
+                muts.extend(v.mutations)
+            attrs = {m.attr for m in muts if m.foreign
+                     if m.func_name != "__init__"}
+            attrs -= info.lock_attrs
+            attrs -= info.thread_owned_attrs
+            attrs -= info.synced_attrs
+            if not attrs:
+                continue
+            model.setdefault(src.path, {})[node.name] = {
+                "attrs": sorted(attrs),
+                "locks": sorted(info.lock_attrs),
+            }
+    return model
+
+
 def check(files: list[FileSource]) -> list[Finding]:
     findings: list[Finding] = []
     for src in files:

@@ -1,0 +1,245 @@
+"""rpc-discipline: pin the repo's RPC-plane correctness conventions.
+
+Three rules, all statically checkable (the modules are parsed, never
+imported):
+
+1. **journal-before-reply** (scheduler). In any file declaring both
+   ``_MUTATING_OPS`` and ``_JOURNALED_OPS`` frozenset literals, every
+   mutating op must be journaled — the WAL contract is effect ->
+   journal -> reply, and a mutating op outside ``_JOURNALED_OPS``
+   would survive neither a crash nor a replay. An op is exempt only if
+   it is special-cased by name (``op == "<name>"``) inside the
+   function that appends the RPC journal record (``get`` today: only
+   journaled when it actually assigned a part). The reverse direction
+   is also checked: a journaled op that is not declared mutating has
+   no per-sender seq and would replay double.
+
+2. **shed-before-dispatch** (frame servers). Any function that calls
+   ``recv_frame`` and dispatches through a ``*dispatch*`` attribute
+   (the ps_server/serving handler-loop shape) must consult
+   ``should_shed`` (deadline shed) and ``try_enter`` (admission gate)
+   before the first dispatch call. A handler loop that grew a new op
+   path or was copied without the overload plumbing fails here.
+
+3. **inc-stamp** (reply-cache liveness). In a class that both keeps a
+   reply cache (``self._replies[...] = ...``) and carries an
+   ``incarnation``, every ``return`` of the ``_dispatch`` method must
+   stamp ``inc`` — a dict literal with an ``"inc"`` key, a
+   ``dict(..., inc=...)`` call, or a variable assigned ``var["inc"] =
+   ...`` earlier in the function. A cached reply re-sent without the
+   live incarnation would un-fence clients across a restart.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import FileSource, Finding, terminal_name
+
+CHECKER = "rpc-discipline"
+
+_MUT_NAME = "_MUTATING_OPS"
+_JRN_NAME = "_JOURNALED_OPS"
+
+
+def _frozenset_literal(node: ast.AST) -> Optional[set[str]]:
+    """String members of ``frozenset({...})`` / ``frozenset((...))``."""
+    if not (isinstance(node, ast.Call)
+            and terminal_name(node.func) == "frozenset" and node.args):
+        return None
+    arg = node.args[0]
+    if not isinstance(arg, (ast.Set, ast.Tuple, ast.List)):
+        return None
+    out = set()
+    for elt in arg.elts:
+        if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+            return None
+        out.add(elt.value)
+    return out
+
+
+def _op_sets(src: FileSource) -> dict[str, tuple[set[str], int]]:
+    """{set name: (members, line)} for the two op frozensets."""
+    out: dict[str, tuple[set[str], int]] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id in (_MUT_NAME, _JRN_NAME):
+                members = _frozenset_literal(node.value)
+                if members is not None:
+                    out[tgt.id] = (members, node.lineno)
+    return out
+
+
+def _journal_special_cases(src: FileSource) -> set[str]:
+    """Op names compared by equality inside any function that appends
+    an RPC journal record — the conditional-journal escape hatch."""
+    out: set[str] = set()
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        records = any(
+            isinstance(c, ast.Call) and isinstance(c.func, ast.Attribute)
+            and c.func.attr == "record"
+            and "journal" in (terminal_name(c.func.value) or "").lower()
+            for c in ast.walk(fn))
+        if not records:
+            continue
+        for cmp in ast.walk(fn):
+            if not isinstance(cmp, ast.Compare):
+                continue
+            for comparator in cmp.comparators:
+                if isinstance(comparator, ast.Constant) and \
+                        isinstance(comparator.value, str):
+                    out.add(comparator.value)
+    return out
+
+
+def _check_journal(src: FileSource) -> list[Finding]:
+    sets = _op_sets(src)
+    if _MUT_NAME not in sets or _JRN_NAME not in sets:
+        return []
+    mutating, mut_line = sets[_MUT_NAME]
+    journaled, jrn_line = sets[_JRN_NAME]
+    special = _journal_special_cases(src)
+    findings = []
+    for op in sorted(mutating - journaled - special):
+        findings.append(Finding(
+            CHECKER, src.path, mut_line, key=f"mutating-unjournaled:{op}",
+            message=(f"mutating op `{op}` is not in {_JRN_NAME} and has no "
+                     f"conditional-journal special case — a crash after its "
+                     f"effect loses it and a replay cannot restore it")))
+    for op in sorted(journaled - mutating):
+        findings.append(Finding(
+            CHECKER, src.path, jrn_line, key=f"journaled-not-mutating:{op}",
+            message=(f"journaled op `{op}` is not declared in {_MUT_NAME}: "
+                     f"it carries no per-sender seq, so a client retry "
+                     f"would re-execute it on replay")))
+    return findings
+
+
+def _enclosing_class(tree: ast.AST) -> dict[int, str]:
+    """id(function node) -> class name, for finding keys."""
+    out: dict[int, str] = {}
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            for fn in ast.walk(cls):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[id(fn)] = cls.name
+    return out
+
+
+def _check_handler_loops(src: FileSource) -> list[Finding]:
+    findings: list[Finding] = []
+    classes = _enclosing_class(src.tree)
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        recv_line = shed_line = enter_line = dispatch_line = None
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            name = terminal_name(call.func)
+            if name == "recv_frame" and recv_line is None:
+                recv_line = call.lineno
+            elif name == "should_shed" and shed_line is None:
+                shed_line = call.lineno
+            elif name == "try_enter" and enter_line is None:
+                enter_line = call.lineno
+            elif isinstance(call.func, ast.Attribute) and \
+                    "dispatch" in call.func.attr and dispatch_line is None:
+                dispatch_line = call.lineno
+        if recv_line is None or dispatch_line is None:
+            continue
+        where = f"{classes.get(id(fn), '<module>')}.{fn.name}"
+        for what, line in (("should_shed", shed_line),
+                           ("try_enter", enter_line)):
+            if line is None:
+                findings.append(Finding(
+                    CHECKER, src.path, fn.lineno,
+                    key=f"{where}:missing-{what.replace('_', '-')}",
+                    message=(f"handler loop `{where}` dispatches frames "
+                             f"without calling `{what}` — overload "
+                             f"discipline requires deadline shed and "
+                             f"admission before dispatch")))
+            elif line > dispatch_line:
+                findings.append(Finding(
+                    CHECKER, src.path, line,
+                    key=f"{where}:late-{what.replace('_', '-')}",
+                    message=(f"`{what}` in `{where}` runs after the "
+                             f"dispatch call — sheds must precede "
+                             f"dispatch to protect the handler")))
+    return findings
+
+
+def _stamps_inc(ret: ast.Return, stamped_before: set[str]) -> bool:
+    v = ret.value
+    if v is None:
+        return False
+    if isinstance(v, ast.Dict):
+        return any(isinstance(k, ast.Constant) and k.value == "inc"
+                   for k in v.keys)
+    if isinstance(v, ast.Call) and terminal_name(v.func) == "dict":
+        if any(kw.arg == "inc" for kw in v.keywords):
+            return True
+        return v.args and isinstance(v.args[0], ast.Name) and \
+            v.args[0].id in stamped_before
+    if isinstance(v, ast.Name):
+        return v.id in stamped_before
+    return False
+
+
+def _check_inc_stamp(src: FileSource) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        has_cache = has_inc = False
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Subscript) and \
+                    terminal_name(node.value) == "_replies":
+                has_cache = True
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "incarnation":
+                has_inc = True
+        if not (has_cache and has_inc):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name != "_dispatch":
+                continue
+            # variables assigned var["inc"] = ... anywhere in the body
+            stamped: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                isinstance(tgt.slice, ast.Constant) and \
+                                tgt.slice.value == "inc":
+                            stamped.add(tgt.value.id)
+            for ret in ast.walk(fn):
+                if isinstance(ret, ast.Return) and \
+                        not _stamps_inc(ret, stamped):
+                    findings.append(Finding(
+                        CHECKER, src.path, ret.lineno,
+                        key=f"{cls.name}._dispatch:unstamped-return",
+                        message=(f"`{cls.name}._dispatch` returns a reply "
+                                 f"without stamping `inc` — replies (cached "
+                                 f"ones included) must carry the live "
+                                 f"incarnation to fence restarts")))
+                    break  # one finding per method keeps the key stable
+    return findings
+
+
+def check(files: list[FileSource]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in files:
+        findings.extend(_check_journal(src))
+        if "recv_frame" in src.text:
+            findings.extend(_check_handler_loops(src))
+        if "_replies" in src.text and "incarnation" in src.text:
+            findings.extend(_check_inc_stamp(src))
+    return findings

@@ -1,0 +1,27 @@
+"""wormsan: default-off runtime concurrency sanitizer for wormhole-tpu.
+
+Arm with ``WH_SAN=1`` (wormhole_tpu/__init__.py installs the hooks at
+import, before any submodule creates a lock).  Three detectors — lock
+acquisition-order cycles, blocking calls under registry-known locks, and
+a sampled Eraser-style lockset race pass over the shared-state model
+wormlint's lock-discipline checker infers (``shared_state_model`` in
+tools/wormlint/locks.py: static and dynamic analysis share one model).
+
+Knobs: ``WH_SAN`` (arm), ``WH_SAN_SAMPLE`` (race-check 1-in-N writes),
+``WH_SAN_DUMP_DIR`` (JSONL finding dumps; replay with
+``python -m tools.wormsan <dir>``).  ``python -m tools.wormsan
+--selftest`` proves each detector fires on a seeded fixture.
+See docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from .core import (DETECTORS, ENV_DUMP_DIR, ENV_ENABLE, ENV_SAMPLE, SanLock,
+                   SanRLock, enabled, env_enabled, findings, install,
+                   instrument_classes, load_model, reset, summary,
+                   watch_class)
+
+__all__ = ["DETECTORS", "ENV_DUMP_DIR", "ENV_ENABLE", "ENV_SAMPLE",
+           "SanLock", "SanRLock", "enabled", "env_enabled", "findings",
+           "install", "instrument_classes", "load_model", "reset",
+           "summary", "watch_class"]

@@ -65,4 +65,34 @@ def _install_stackdump() -> None:
 
 _install_stackdump()
 
+
+def _arm_wormsan() -> bool:
+    """WH_SAN=1: install the runtime concurrency sanitizer
+    (tools/wormsan) before any submodule import creates a lock, so every
+    ``threading.Lock``/``RLock`` in the process is wrapped.  Class
+    instrumentation (the lockset race detector over wormlint's
+    shared-state model) is deferred to after this package finishes
+    importing — instrumenting imports the model's modules, which would
+    re-enter a half-initialized wormhole_tpu."""
+    if _os.environ.get("WH_SAN") != "1":
+        return False
+    try:
+        from tools import wormsan
+    except ImportError:
+        import sys as _sys
+
+        _sys.stderr.write("[wormsan] WH_SAN=1 but tools.wormsan is not "
+                          "importable (run from the repo root)\n")
+        return False
+    wormsan.install(instrument=False)
+    return True
+
+
+_WORMSAN_ARMED = _arm_wormsan()
+
 from wormhole_tpu.data.rowblock import RowBlock, DeviceBatch  # noqa: F401
+
+if _WORMSAN_ARMED:
+    from tools import wormsan as _wormsan
+
+    _wormsan.instrument_classes()

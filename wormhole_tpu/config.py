@@ -320,6 +320,20 @@ declare_knob("WH_FLIGHT_MIN_SEC", float, 10.0,
              "Minimum seconds between unforced flight dumps on one node "
              "(dump storms from repeated triggers are suppressed).",
              group="obs")
+declare_knob("WH_SAN", bool, False,
+             "Runtime concurrency sanitizer (tools/wormsan): wraps every "
+             "Lock/RLock to detect lock-order cycles, blocking calls "
+             "under registry-known locks, and sampled lockset races over "
+             "wormlint's shared-state model. Off = nothing is patched.",
+             group="obs")
+declare_knob("WH_SAN_SAMPLE", int, 1,
+             "Sanitizer race-detector sampling: check 1-in-N instrumented "
+             "attribute writes (1 = every write; raise to cut overhead "
+             "under load).", group="obs")
+declare_knob("WH_SAN_DUMP_DIR", str, "",
+             "Directory for san-<pid>.jsonl finding dumps; replay with "
+             "`python -m tools.wormsan <dir>`. Empty = in-process and "
+             "stderr reporting only.", group="obs")
 
 # data pipeline
 declare_knob("WH_PACK_CACHE", bool, False,

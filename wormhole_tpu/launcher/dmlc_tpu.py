@@ -115,7 +115,9 @@ def launch(num_workers: int, num_servers: int, cmd: list[str],
                                         "WH_FLIGHT_DECISIONS",
                                         "WH_FLIGHT_SNAPS",
                                         "WH_FLIGHT_DIR",
-                                        "WH_FLIGHT_MIN_SEC")) -> int:
+                                        "WH_FLIGHT_MIN_SEC",
+                                        "WH_SAN", "WH_SAN_SAMPLE",
+                                        "WH_SAN_DUMP_DIR")) -> int:
     """Spawn the scheduler + N workers of `cmd`; stream their output with
     role prefixes; return the first nonzero exit code (0 if all clean).
     On scheduler exit, surviving workers are terminated (the reference

@@ -69,6 +69,96 @@ WIRE_ENCODINGS = ("raw", "bf16", "int8", "int4")
 # same-significance bytes (exponents especially) group into long runs
 WIRE_COMP_MODES = ("", "zlib", "bshuf")
 
+# Central declaration table for every top-level header key the frame
+# protocol (and the scheduler's newline-JSON RPC) carries. The
+# `frame-header` wormlint checker parses this dict literal statically
+# (never importing the module) and flags any undeclared key read or
+# written at a header site — the wire vocabulary equivalent of the
+# obs/names.py metric registry. Per-array metadata (the entries of the
+# "arrays" list: name/shape/enc/scale/nbytes/comp/rawbytes/dlt/gs/goff)
+# is the codec's own and is not declared here.
+# fmt: off
+HEADER_KEYS: dict[str, str] = {
+    # -- every frame / every plane
+    "op": "request verb (push/pull/fetch/score/hello/bsp_step/...)",
+    "arrays": "per-payload array metadata list (codec-owned fields)",
+    "sender": "stable client identity for seq dedup and reply caching",
+    "seq": "per-sender request sequence number (exactly-once retries)",
+    "error": "reply-side failure message; absence means success",
+    "ok": "reply-side success marker",
+    "tctx": "sampled request-trace context (obs/trace.py bind_wire)",
+    "dl": "relative deadline budget in seconds, stamped at send",
+    "dl_mono": "receiver-anchored absolute deadline (overload.arm)",
+    "shed": "reply marker: the deadline expired before dispatch",
+    "busy": "reply marker: the admission gate bounced this frame",
+    "retry_ms": "suggested client backoff attached to a busy reply",
+    # -- hello negotiation (PS + serving)
+    "net_compress": "both ends agree to zlib frame compression",
+    "wire": "negotiated value encoding (WIRE_ENCODINGS) for payloads",
+    "wire_comp": "negotiated frame compression mode (WIRE_COMP_MODES)",
+    "wire_ef": "client uses error-feedback residuals on quantized pushes",
+    "comp_reply": "server will compress its replies to this client",
+    "world": "shard-group size echoed in hello (config cross-check)",
+    # -- PS data plane (runtime/ps_server.py)
+    "epoch": "server restore epoch stamped on every PS reply",
+    "full_rows": "table name -> row count map (init / hello replies)",
+    "specs": "table name -> dtype/shape spec map (init_spec)",
+    "derived": "derived-table expressions shipped with init_spec",
+    "since": "client clock for incremental pulls",
+    "skip": "pull reply: rows unchanged since `since`, payload omitted",
+    "clock": "server logical clock stamped on pull replies",
+    "last_seq": "highest per-sender push seq the server has applied",
+    "dup": "push reply: seq already applied, delta dropped (dedup)",
+    "kc": "client requests key-list digest caching for this push",
+    "kdig": "group -> key-list digest map (key cache probe)",
+    "kfull": "group -> digest map acknowledging a full key resend",
+    "known": "digest probe reply: all digests matched the cache",
+    "need": "digest probe reply: groups needing a full key resend",
+    "need_keys": "push reply: digest missed, client must resend keys",
+    "base": "snapshot base path for save/load ops",
+    "iter": "snapshot iteration label for save/load ops",
+    # -- serving plane (serving/server.py, serving/router.py)
+    "version": "model snapshot version stamped on serving replies",
+    "kind": "score-op model kind (linear/difacto)",
+    "rows": "live row count of a score round's fold target",
+    "tables": "table names requested by a fetch",
+    "rep": "fetch wants replicated (full) tables, not range slices",
+    "queue_s": "shard-side recv-to-dispatch queue wait (stage attribution)",
+    "served_s": "shard-side handler service time (stage attribution)",
+    "degraded": "reply served under degraded mode (bounded staleness)",
+    "threshold": "difacto admission threshold for the score op",
+    "vb": "difacto V-table hash buckets for the score op",
+    "l1_shrk": "difacto l1-shrink admission flag for the score op",
+    # -- BSP collective plane (runtime/allreduce.py)
+    "gen": "group membership generation (tracker-owned fencing)",
+    "ver": "BSP checkpoint version of the collective",
+    "t": "ring step index within one allreduce round",
+    "src": "sending rank of a bsp_step frame",
+    "hit": "bsp_fetch reply: the cached reduced result was present",
+    "next": "bsp_fetch reply: (ver, seq) the peer advanced to",
+    # -- scheduler control plane (runtime/tracker.py, newline-JSON RPC)
+    "inc": "scheduler incarnation stamped on every reply (restart fence)",
+    "fgen": "flight-recorder trigger generation piggybacked on replies",
+    "fwhy": "flight-recorder trigger reason piggybacked on replies",
+    "node": "reporting node's name (heartbeats, registrations)",
+    "rank": "role-group rank of the registering node",
+    "uri": "RPC endpoint the registering node listens on",
+    "part_id": "workload part id assigned by get / finished by finish",
+    "mepoch": "membership epoch stamped on part grants and completions",
+    "metrics": "heartbeat-piggybacked metrics snapshot",
+    "format": "workload pattern format argument of add_local",
+    "files": "workload file list argument of add_local",
+    "progress": "progress blob attached to a finish/report op",
+    "data": "blob payload of blob_put",
+    "key": "blob name of blob_put/blob_get/blob_del",
+    "name": "barrier name of a barrier/barrier_wait op",
+    "target": "desired worker count in an elastic reply",
+    "history": "metrics verb: client wants the telemetry ring, not a spot",
+    "slo": "metrics verb: client wants SLO burn judgments included",
+    "reason": "flight-trigger op: why the cluster dump fired",
+}
+# fmt: on
+
 # handles cached at import: per-frame cost is an inc, never a dict walk
 _FRAMES_SENT = _obs.REGISTRY.counter("net.frames_sent")
 _FRAMES_RECV = _obs.REGISTRY.counter("net.frames_recv")

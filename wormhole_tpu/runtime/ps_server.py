@@ -1542,7 +1542,9 @@ class PSClient:
                 if self.resolver is not None:
                     uris = self.resolver()
                     if uris and len(uris) == self.world:
-                        self.uris = list(uris)
+                        # atomic rebind of a complete snapshot: racing
+                        # fan threads each publish a full resolved list
+                        self.uris = list(uris)  # wormsan: allow=race
                 self.close(r)
                 host, port = self.uris[r].rsplit(":", 1)
                 s = connect_with_retry(

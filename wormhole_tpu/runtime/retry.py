@@ -173,7 +173,13 @@ def connect(addr: tuple[str, int], deadline_s: float = 30.0,
     30s dial loop or a 60s blocking connect)."""
     rem = _overload.remaining()
     if rem is not None:
-        rem = max(rem, 1e-3)  # expired: one fast attempt, then give up
+        # expired: one fast attempt, then give up.  The floor must
+        # still cover a localhost round-trip — the shed reply ("deadline
+        # expired before dispatch") travels back over this same socket,
+        # and a sub-millisecond I/O timeout turns every expired-budget
+        # call into an opaque socket timeout instead of the typed shed
+        # error the caller is supposed to see
+        rem = max(rem, 0.05)
         deadline_s = min(deadline_s, rem)
         timeout = min(timeout, rem)
     budget = RetryBudget(deadline_s, op=op)
